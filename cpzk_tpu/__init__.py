@@ -1,0 +1,60 @@
+"""cpzk_tpu — TPU-native Chaum-Pedersen zero-knowledge proof framework.
+
+A ground-up re-design of the capabilities of the reference Rust crate
+``chaum-pedersen-zkp`` (see /root/reference) for TPU hardware:
+
+- **Host plane** (this package's ``core/`` + ``protocol/``): bit-exact
+  ristretto255 group arithmetic, Merlin-style Fiat-Shamir transcripts, the
+  109-byte proof codec, and single-proof prove/verify — the trusted,
+  constant-time-disciplined path (reference: ``src/primitives/``,
+  ``src/prover/``, ``src/verifier/mod.rs``).
+- **TPU data plane** (``ops/`` + ``parallel/``): batched limb-vector field
+  arithmetic, extended-coordinate point kernels, windowed scalar
+  multiplication and batch verification as JAX/XLA programs, sharded over
+  ``jax.sharding.Mesh`` for multi-chip scale (reference analog:
+  ``src/verifier/batch.rs``, re-designed — not translated).
+- **Serving plane** (``server/`` + ``client/``): the gRPC auth system
+  (reference: ``src/verifier/service.rs``, ``src/bin/``).
+
+Public facade mirrors the reference's ``src/lib.rs:79-88`` re-export set.
+"""
+
+from .errors import Error, InvalidGroupElement, InvalidParams, InvalidScalar
+from .core.ristretto import Element, Ristretto255, Scalar
+from .core.rng import SecureRng
+from .core.transcript import Transcript
+from .protocol.gadgets import (
+    Commitment,
+    Parameters,
+    Proof,
+    Response,
+    Statement,
+    Witness,
+)
+from .protocol.prover import Nonce, Prover
+from .protocol.verifier import Verifier
+from .protocol.batch import BatchVerifier
+
+__version__ = "1.0.0"
+
+__all__ = [
+    "BatchVerifier",
+    "Commitment",
+    "Element",
+    "Error",
+    "InvalidGroupElement",
+    "InvalidParams",
+    "InvalidScalar",
+    "Nonce",
+    "Parameters",
+    "Proof",
+    "Prover",
+    "Response",
+    "Ristretto255",
+    "Scalar",
+    "SecureRng",
+    "Statement",
+    "Transcript",
+    "Verifier",
+    "Witness",
+]

@@ -1,0 +1,10 @@
+"""Host-plane cryptographic core.
+
+Pure-Python, integer-exact implementations of the ristretto255 group
+(RFC 9496), the scalar ring mod ℓ, Keccak-f[1600]/STROBE-128 transcripts,
+and the OS CSPRNG wrapper. This is the *oracle* against which the TPU data
+plane (``cpzk_tpu.ops``) and the C++ host library (``core/cpp``) are
+differential-tested, and the trusted path for single-proof operations.
+
+Reference parity: ``src/primitives/`` in /root/reference.
+"""

@@ -1,0 +1,168 @@
+"""Ristretto255 group API — reference ``src/primitives/ristretto.rs`` twin.
+
+``Scalar`` and ``Element`` are immutable newtypes over the integer/extended-
+coordinate representations in :mod:`cpzk_tpu.core.scalars` and
+:mod:`cpzk_tpu.core.edwards`. ``Ristretto255`` is the static namespace whose
+method set mirrors the reference line for line (generators, canonical
+(de)serialization, random scalars via 64-byte wide reduction, group ops,
+recompression validation).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac
+
+from ..errors import InvalidGroupElement, InvalidScalar
+from . import edwards, scalars
+from .rng import SecureRng
+
+RISTRETTO_BYTES = 32
+WIDE_REDUCTION_BYTES = 64
+
+# Domain separation tag for the second generator h (ristretto.rs:27).
+GENERATOR_H_DST = b"chaum-pedersen-zkp-v1.0.0-generator-h"
+
+
+class Scalar:
+    """Scalar mod ℓ. Equality is constant-time on the canonical encoding."""
+
+    __slots__ = ("value",)
+
+    def __init__(self, value: int):
+        self.value = value % scalars.L
+
+    def __eq__(self, other: object) -> bool:
+        if not isinstance(other, Scalar):
+            return NotImplemented
+        # constant-time compare of canonical encodings (subtle::ConstantTimeEq twin)
+        return hmac.compare_digest(scalars.sc_to_bytes(self.value), scalars.sc_to_bytes(other.value))
+
+    def __hash__(self) -> int:
+        return hash(self.value)
+
+    def __repr__(self) -> str:
+        return f"Scalar(0x{self.value:064x})"
+
+
+class Element:
+    """Ristretto255 group element (point coset)."""
+
+    __slots__ = ("point",)
+
+    def __init__(self, point: edwards.Point):
+        self.point = point
+
+    def __eq__(self, other: object) -> bool:
+        if not isinstance(other, Element):
+            return NotImplemented
+        return edwards.pt_eq(self.point, other.point)
+
+    def __hash__(self) -> int:
+        return hash(edwards.ristretto_encode(self.point))
+
+    def __repr__(self) -> str:
+        return f"Element({edwards.ristretto_encode(self.point).hex()})"
+
+
+class Ristretto255:
+    """Static namespace mirroring the reference group API."""
+
+    _GENERATOR_H_CACHE: Element | None = None
+
+    @staticmethod
+    def generator_g() -> Element:
+        return Element(edwards.BASEPOINT)
+
+    @classmethod
+    def generator_h(cls) -> Element:
+        """Second generator: SHA-512(DST) → one-way map (ristretto.rs:86-91)."""
+        if cls._GENERATOR_H_CACHE is None:
+            digest = hashlib.sha512(GENERATOR_H_DST).digest()
+            cls._GENERATOR_H_CACHE = Element(edwards.ristretto_from_uniform_bytes(digest))
+        return cls._GENERATOR_H_CACHE
+
+    @staticmethod
+    def scalar_from_bytes(data: bytes) -> Scalar:
+        if len(data) != RISTRETTO_BYTES:
+            raise InvalidScalar(f"Expected {RISTRETTO_BYTES} bytes, got {len(data)}")
+        v = scalars.sc_from_bytes_canonical(data)
+        if v is None:
+            raise InvalidScalar("Bytes do not represent a valid scalar")
+        return Scalar(v)
+
+    @staticmethod
+    def scalar_to_bytes(scalar: Scalar) -> bytes:
+        return scalars.sc_to_bytes(scalar.value)
+
+    @staticmethod
+    def element_from_bytes(data: bytes) -> Element:
+        if len(data) != RISTRETTO_BYTES:
+            raise InvalidGroupElement(f"Expected {RISTRETTO_BYTES} bytes, got {len(data)}")
+        point = edwards.ristretto_decode(data)
+        if point is None:
+            raise InvalidGroupElement("Bytes do not represent a valid Ristretto point")
+        return Element(point)
+
+    @staticmethod
+    def element_to_bytes(element: Element) -> bytes:
+        return edwards.ristretto_encode(element.point)
+
+    @staticmethod
+    def random_scalar(rng: SecureRng) -> Scalar:
+        return Scalar(scalars.sc_from_bytes_mod_order_wide(rng.fill_bytes(WIDE_REDUCTION_BYTES)))
+
+    @staticmethod
+    def scalar_mul(element: Element, scalar: Scalar) -> Element:
+        return Element(edwards.pt_scalar_mul(element.point, scalar.value))
+
+    @staticmethod
+    def element_mul(a: Element, b: Element) -> Element:
+        """Group operation (written multiplicatively in the protocol; the
+        curve implementation is additive) — ristretto.rs:158-160."""
+        return Element(edwards.pt_add(a.point, b.point))
+
+    @staticmethod
+    def identity() -> Element:
+        return Element(edwards.IDENTITY)
+
+    @staticmethod
+    def is_identity(element: Element) -> bool:
+        return edwards.pt_is_identity(element.point)
+
+    @staticmethod
+    def validate_element(element: Element) -> None:
+        """Recompression validation (ristretto.rs:173-185): identity is valid;
+        otherwise encode→decode must round-trip to the same coset."""
+        if edwards.pt_is_identity(element.point):
+            return
+        compressed = edwards.ristretto_encode(element.point)
+        point = edwards.ristretto_decode(compressed)
+        if point is None or not edwards.pt_eq(point, element.point):
+            raise InvalidGroupElement("Element failed recompression validation")
+
+    @staticmethod
+    def scalar_add(a: Scalar, b: Scalar) -> Scalar:
+        return Scalar(scalars.sc_add(a.value, b.value))
+
+    @staticmethod
+    def scalar_sub(a: Scalar, b: Scalar) -> Scalar:
+        return Scalar(scalars.sc_sub(a.value, b.value))
+
+    @staticmethod
+    def scalar_mul_scalar(a: Scalar, b: Scalar) -> Scalar:
+        return Scalar(scalars.sc_mul(a.value, b.value))
+
+    @staticmethod
+    def scalar_negate(scalar: Scalar) -> Scalar:
+        return Scalar(scalars.sc_neg(scalar.value))
+
+    @staticmethod
+    def scalar_invert(scalar: Scalar) -> Scalar | None:
+        if scalar.value == 0:
+            return None
+        return Scalar(scalars.sc_invert(scalar.value))
+
+    @staticmethod
+    def scalar_is_zero(scalar: Scalar) -> bool:
+        return scalar.value == 0

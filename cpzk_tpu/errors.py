@@ -1,0 +1,22 @@
+"""Shared error taxonomy.
+
+Mirrors the reference's three-variant error enum (``src/error.rs:4-17``):
+``InvalidParams``, ``InvalidScalar``, ``InvalidGroupElement``. The C++ host
+library uses matching integer status codes (see ``core/cpp/``, planned native host library).
+"""
+
+
+class Error(Exception):
+    """Base class for all protocol errors."""
+
+
+class InvalidParams(Error):
+    """Invalid protocol parameters (reference ``Error::InvalidParams``)."""
+
+
+class InvalidScalar(Error):
+    """Invalid scalar encoding/value (reference ``Error::InvalidScalar``)."""
+
+
+class InvalidGroupElement(Error):
+    """Invalid group element encoding/value (reference ``Error::InvalidGroupElement``)."""

@@ -1,0 +1,271 @@
+"""Batch verification — re-designed from reference ``src/verifier/batch.rs``.
+
+API parity: ``BatchVerifier`` accumulates up to ``MAX_BATCH_SIZE`` entries of
+(params, statement, proof, context), validating statements on ``add``
+(batch.rs:139-168); ``verify`` returns per-proof results, short-circuiting a
+single-entry batch to individual verification (batch.rs:171-183) and falling
+back to per-proof verification when the combined check fails
+(batch.rs:314-318) — so the *accept set* is always per-proof ground truth.
+
+Math fix (normative deviation, SURVEY.md §3.2): the reference's combined
+equation drops the random coefficient on the ``y^c`` term
+(batch.rs:297-299), which makes its fast path fail for every n ≥ 2 batch and
+silently degrade to per-proof verification. We implement the correct
+random-linear-combination check
+
+    Σ αᵢ·(sᵢ·G − r1ᵢ − cᵢ·y1ᵢ)  +  β·Σ αᵢ·(sᵢ·H − r2ᵢ − cᵢ·y2ᵢ)  ==  O
+
+with per-entry random αᵢ and one extra random weight β merging the two
+equations (soundness: Schwartz-Zippel over ℓ; per-equation failure
+probability ≤ 2/ℓ). Observable accept/reject semantics are identical to the
+reference because its fallback already defines acceptance per-proof.
+
+The heavy lifting is delegated to a pluggable ``VerifierBackend``:
+``CpuBackend`` (host oracle, default) or the TPU/JAX backend in
+:mod:`cpzk_tpu.ops.backend` (one big vectorized pass; see BASELINE.json
+north star).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from ..errors import Error, InvalidParams
+from ..core import edwards
+from ..core.ristretto import Element, Ristretto255, Scalar
+from ..core.rng import SecureRng
+from ..core.scalars import L, sc_mul
+from ..core.transcript import Transcript
+from .gadgets import Parameters, Proof, Statement
+from .verifier import Verifier
+
+MAX_BATCH_SIZE = 1000
+
+
+@dataclass
+class BatchEntry:
+    params: Parameters
+    statement: Statement
+    proof: Proof
+    transcript_context: bytes | None
+
+
+@dataclass
+class BatchRow:
+    """Flattened, challenge-resolved entry handed to a backend."""
+
+    g: Element
+    h: Element
+    y1: Element
+    y2: Element
+    r1: Element
+    r2: Element
+    s: Scalar
+    c: Scalar
+    alpha: Scalar
+
+
+class VerifierBackend:
+    """Backend interface for the batch-verification compute plane."""
+
+    #: Whether the combined RLC fast path is actually faster than per-proof
+    #: checks on this backend. False for the scalar CPU oracle (4n+2 muls vs
+    #: 4n, and a failed combined check pays both passes); True for vectorized
+    #: backends where the combined check amortizes.
+    prefers_combined: bool = True
+
+    def verify_combined(self, rows: list[BatchRow], beta: Scalar) -> bool:
+        """Corrected-RLC combined check; True iff the whole batch passes."""
+        raise NotImplementedError
+
+    def verify_each(self, rows: list[BatchRow]) -> list[bool]:
+        """Per-proof ground-truth checks (the accept-set decider)."""
+        raise NotImplementedError
+
+
+class CpuBackend(VerifierBackend):
+    """Host-plane backend over the integer-exact core (the oracle)."""
+
+    prefers_combined = False
+
+    def verify_combined(self, rows: list[BatchRow], beta: Scalar) -> bool:
+        acc = edwards.IDENTITY
+        sum_as = 0  # Σ αᵢ·sᵢ mod ℓ
+        for row in rows:
+            a = row.alpha.value
+            ac = sc_mul(a, row.c.value)
+            sum_as = (sum_as + a * row.s.value) % L
+            # subtract αᵢ·r1ᵢ + (αᵢcᵢ)·y1ᵢ + β·(αᵢ·r2ᵢ + (αᵢcᵢ)·y2ᵢ)
+            term = edwards.pt_add(
+                edwards.pt_scalar_mul(row.r1.point, a),
+                edwards.pt_scalar_mul(row.y1.point, ac),
+            )
+            term2 = edwards.pt_add(
+                edwards.pt_scalar_mul(row.r2.point, sc_mul(a, beta.value)),
+                edwards.pt_scalar_mul(row.y2.point, sc_mul(ac, beta.value)),
+            )
+            acc = edwards.pt_add(acc, edwards.pt_add(term, term2))
+        # add (Σαs)·G + β(Σαs)·H — valid only when all rows share generators;
+        # the dispatcher (BatchVerifier.verify) only takes this fast path in
+        # that case and sends mixed-generator batches to verify_each.
+        g = rows[0].g.point
+        h = rows[0].h.point
+        lhs = edwards.pt_add(
+            edwards.pt_scalar_mul(g, sum_as),
+            edwards.pt_scalar_mul(h, sc_mul(sum_as, beta.value)),
+        )
+        return edwards.pt_eq(lhs, acc)
+
+    def verify_each(self, rows: list[BatchRow]) -> list[bool]:
+        out = []
+        for row in rows:
+            lhs1 = edwards.pt_scalar_mul(row.g.point, row.s.value)
+            rhs1 = edwards.pt_add(row.r1.point, edwards.pt_scalar_mul(row.y1.point, row.c.value))
+            lhs2 = edwards.pt_scalar_mul(row.h.point, row.s.value)
+            rhs2 = edwards.pt_add(row.r2.point, edwards.pt_scalar_mul(row.y2.point, row.c.value))
+            out.append(edwards.pt_eq(lhs1, rhs1) and edwards.pt_eq(lhs2, rhs2))
+        return out
+
+
+_DEFAULT_BACKEND: VerifierBackend | None = None
+
+
+def default_backend() -> VerifierBackend:
+    """Process-wide default backend (CPU oracle unless overridden)."""
+    global _DEFAULT_BACKEND
+    if _DEFAULT_BACKEND is None:
+        _DEFAULT_BACKEND = CpuBackend()
+    return _DEFAULT_BACKEND
+
+
+def set_default_backend(backend: VerifierBackend | None) -> None:
+    global _DEFAULT_BACKEND
+    _DEFAULT_BACKEND = backend
+
+
+class BatchVerifier:
+    """Accumulate-and-verify batch API (reference ``BatchVerifier`` twin)."""
+
+    def __init__(self, backend: VerifierBackend | None = None):
+        self.entries: list[BatchEntry] = []
+        self._backend = backend
+
+    @staticmethod
+    def with_capacity(capacity: int, backend: VerifierBackend | None = None) -> "BatchVerifier":
+        """Capacity is clamped to MAX_BATCH_SIZE (batch.rs:107-117); Python
+        lists need no preallocation, so this is a naming-parity constructor."""
+        if capacity < 0:
+            raise InvalidParams("Capacity cannot be negative")
+        return BatchVerifier(backend)
+
+    def __len__(self) -> int:
+        return len(self.entries)
+
+    def is_empty(self) -> bool:
+        return not self.entries
+
+    def remaining_capacity(self) -> int:
+        return max(0, MAX_BATCH_SIZE - len(self.entries))
+
+    def clear(self) -> None:
+        """Empty the batch for reuse (reference BatchVerifier::clear)."""
+        self.entries.clear()
+
+    def add(self, params: Parameters, statement: Statement, proof: Proof) -> None:
+        self.add_with_context(params, statement, proof, None)
+
+    def add_with_context(
+        self,
+        params: Parameters,
+        statement: Statement,
+        proof: Proof,
+        context: bytes | None,
+    ) -> None:
+        """Validates the statement on add (batch.rs:139-168)."""
+        if len(self.entries) >= MAX_BATCH_SIZE:
+            raise InvalidParams(f"Batch size limit exceeded (max {MAX_BATCH_SIZE})")
+        statement.validate()
+        self.entries.append(BatchEntry(params, statement, proof, context))
+
+    # --- verification ---
+
+    def _challenge(self, entry: BatchEntry) -> Scalar:
+        """Rebuild the Fiat-Shamir transcript for one entry (batch.rs:239-260)."""
+        transcript = Transcript()
+        if entry.transcript_context is not None:
+            transcript.append_context(entry.transcript_context)
+        transcript.append_parameters(
+            Ristretto255.element_to_bytes(entry.params.generator_g),
+            Ristretto255.element_to_bytes(entry.params.generator_h),
+        )
+        transcript.append_statement(
+            Ristretto255.element_to_bytes(entry.statement.y1),
+            Ristretto255.element_to_bytes(entry.statement.y2),
+        )
+        transcript.append_commitment(
+            Ristretto255.element_to_bytes(entry.proof.commitment.r1),
+            Ristretto255.element_to_bytes(entry.proof.commitment.r2),
+        )
+        return transcript.challenge_scalar()
+
+    def _rows(self, rng: SecureRng) -> list[BatchRow]:
+        rows = []
+        for entry in self.entries:
+            rows.append(
+                BatchRow(
+                    g=entry.params.generator_g,
+                    h=entry.params.generator_h,
+                    y1=entry.statement.y1,
+                    y2=entry.statement.y2,
+                    r1=entry.proof.commitment.r1,
+                    r2=entry.proof.commitment.r2,
+                    s=entry.proof.response.s,
+                    c=self._challenge(entry),
+                    alpha=Ristretto255.random_scalar(rng),
+                )
+            )
+        return rows
+
+    def verify(self, rng: SecureRng) -> list[Error | None]:
+        """Verify all entries; per-entry ``None`` (ok) or ``Error``.
+
+        Mirrors batch.rs:171-183: empty batch is an error; n == 1 verifies
+        individually; otherwise the combined check decides the fast path and
+        failure falls back to per-proof results.
+        """
+        if not self.entries:
+            raise InvalidParams("Cannot verify empty batch")
+        if len(self.entries) == 1:
+            return [self._verify_one(0)]
+
+        backend = self._backend or default_backend()
+        rows = self._rows(rng)
+
+        same_generators = all(
+            r.g == rows[0].g and r.h == rows[0].h for r in rows
+        )
+        beta = Ristretto255.random_scalar(rng)
+        if (
+            same_generators
+            and backend.prefers_combined
+            and backend.verify_combined(rows, beta)
+        ):
+            return [None] * len(rows)
+
+        # Fallback: per-proof ground truth (batch.rs:314-318)
+        results: list[Error | None] = []
+        for ok in backend.verify_each(rows):
+            results.append(None if ok else InvalidParams("Proof verification failed"))
+        return results
+
+    def _verify_one(self, index: int) -> Error | None:
+        entry = self.entries[index]
+        transcript = Transcript()
+        if entry.transcript_context is not None:
+            transcript.append_context(entry.transcript_context)
+        verifier = Verifier(entry.params, entry.statement)
+        try:
+            verifier.verify_with_transcript(entry.proof, transcript)
+            return None
+        except Error as exc:
+            return exc

@@ -1,0 +1,69 @@
+"""Field-layer unit tests: GF(2^255-19), sqrt_ratio_m1, scalar ring."""
+
+import random
+
+from cpzk_tpu.core import field, scalars
+
+
+def test_constants_consistency():
+    # d = -121665/121666
+    assert field.fmul(field.D, 121666) == field.fneg(121665)
+    # sqrt(-1)^2 == -1
+    assert field.fmul(field.SQRT_M1, field.SQRT_M1) == field.P - 1
+    # derived ristretto constants
+    assert field.ONE_MINUS_D_SQ == (1 - field.D * field.D) % field.P
+    assert field.fmul(field.SQRT_AD_MINUS_ONE, field.SQRT_AD_MINUS_ONE) == (-(field.D + 1)) % field.P
+    inv2 = field.fmul(field.INVSQRT_A_MINUS_D, field.INVSQRT_A_MINUS_D)
+    assert field.fmul(inv2, (-1 - field.D) % field.P) == 1
+
+
+def test_sqrt_ratio_m1_cases():
+    # (0, 0) -> (True, 0)
+    assert field.sqrt_ratio_m1(0, 0) == (True, 0)
+    # (u, 0) with u != 0 -> (False, 0)
+    assert field.sqrt_ratio_m1(3, 0) == (False, 0)
+    rng = random.Random(1234)
+    squares = 0
+    for _ in range(50):
+        u = rng.randrange(1, field.P)
+        v = rng.randrange(1, field.P)
+        ok, r = field.sqrt_ratio_m1(u, v)
+        if ok:
+            # r^2 * v == u
+            assert field.fmul(field.fmul(r, r), v) == u
+            squares += 1
+        else:
+            # r^2 * v == SQRT_M1 * u
+            assert field.fmul(field.fmul(r, r), v) == field.fmul(field.SQRT_M1, u)
+        assert not field.is_negative(r)
+    assert 0 < squares < 50  # both branches exercised
+
+
+def test_field_inverse_and_abs():
+    rng = random.Random(99)
+    for _ in range(20):
+        a = rng.randrange(1, field.P)
+        assert field.fmul(a, field.finv(a)) == 1
+        assert field.fabs(a) % 2 == 0
+        assert field.fabs(a) in (a, field.P - a)
+
+
+def test_scalar_ring():
+    rng = random.Random(7)
+    for _ in range(20):
+        a = rng.randrange(scalars.L)
+        b = rng.randrange(scalars.L)
+        assert scalars.sc_sub(scalars.sc_add(a, b), b) == a
+        assert scalars.sc_mul(a, b) == scalars.sc_mul(b, a)
+        if a:
+            assert scalars.sc_mul(a, scalars.sc_invert(a)) == 1
+
+
+def test_scalar_canonical_bytes():
+    assert scalars.sc_from_bytes_canonical(scalars.sc_to_bytes(5)) == 5
+    # ℓ itself is non-canonical
+    assert scalars.sc_from_bytes_canonical(scalars.L.to_bytes(32, "little")) is None
+    assert scalars.sc_from_bytes_canonical((scalars.L - 1).to_bytes(32, "little")) == scalars.L - 1
+    # wide reduction
+    wide = (scalars.L + 17).to_bytes(64, "little")
+    assert scalars.sc_from_bytes_mod_order_wide(wide) == 17

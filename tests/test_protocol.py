@@ -1,0 +1,181 @@
+"""Protocol-layer tests: gadgets, codec, prover/verifier.
+
+Mirrors the reference inline tests (gadgets.rs:492-653, prover/mod.rs:154-197,
+verifier/mod.rs:174-230)."""
+
+import pytest
+
+from cpzk_tpu import (
+    Commitment,
+    InvalidParams,
+    Parameters,
+    Proof,
+    Prover,
+    Response,
+    Ristretto255,
+    Scalar,
+    SecureRng,
+    Statement,
+    Transcript,
+    Verifier,
+    Witness,
+)
+from cpzk_tpu.protocol.gadgets import PROTOCOL_VERSION
+
+
+@pytest.fixture(scope="module")
+def rng():
+    return SecureRng()
+
+
+@pytest.fixture(scope="module")
+def params():
+    return Parameters.new()
+
+
+def make_proof(params, rng):
+    x = Ristretto255.random_scalar(rng)
+    prover = Prover(params, Witness(x))
+    return prover, prover.prove(rng)
+
+
+def test_parameters_default(params):
+    assert params.generator_g == Ristretto255.generator_g()
+    assert params.generator_h == Ristretto255.generator_h()
+
+
+def test_parameters_rejects_identity_and_equal():
+    ident = Ristretto255.identity()
+    g = Ristretto255.generator_g()
+    with pytest.raises(InvalidParams):
+        Parameters.with_generators(ident, g)
+    with pytest.raises(InvalidParams):
+        Parameters.with_generators(g, ident)
+    with pytest.raises(InvalidParams):
+        Parameters.with_generators(g, g)
+
+
+def test_statement_from_witness(params, rng):
+    x = Ristretto255.random_scalar(rng)
+    st = Statement.from_witness(params, Witness(x))
+    assert st.y1 == Ristretto255.scalar_mul(params.generator_g, x)
+    assert st.y2 == Ristretto255.scalar_mul(params.generator_h, x)
+    st.validate()
+
+
+def test_proof_wire_format_109_bytes(params, rng):
+    _, proof = make_proof(params, rng)
+    data = proof.to_bytes()
+    assert len(data) == 109  # CHANGELOG.md:113 parity
+    assert data[0] == PROTOCOL_VERSION
+    assert int.from_bytes(data[1:5], "big") == 32
+    parsed = Proof.from_bytes(data)
+    assert parsed.commitment == proof.commitment
+    assert parsed.response.s == proof.response.s
+
+
+@pytest.mark.parametrize(
+    "mutate",
+    [
+        lambda b: b"",  # empty
+        lambda b: b[:4],  # tiny
+        lambda b: bytes([99]) + b[1:],  # wrong version
+        lambda b: b[:1] + (0).to_bytes(4, "big") + b[5:],  # zero-length field
+        lambda b: b[:1] + (0xFFFFFFFF).to_bytes(4, "big") + b[5:],  # excessive length
+        lambda b: b + b"\xff",  # trailing byte
+        lambda b: b[:-1],  # truncated
+    ],
+)
+def test_proof_from_bytes_rejects(params, rng, mutate):
+    _, proof = make_proof(params, rng)
+    with pytest.raises(InvalidParams):
+        Proof.from_bytes(mutate(proof.to_bytes()))
+
+
+def test_proof_rejects_identity_commitment(params, rng):
+    _, proof = make_proof(params, rng)
+    bad = Proof(Commitment(Ristretto255.identity(), proof.commitment.r2), proof.response)
+    with pytest.raises(InvalidParams):
+        Proof.from_bytes(bad.to_bytes())
+
+
+def test_proof_rejects_zero_response(params, rng):
+    _, proof = make_proof(params, rng)
+    bad = Proof(proof.commitment, Response(Scalar(0)))
+    with pytest.raises(InvalidParams):
+        Proof.from_bytes(bad.to_bytes())
+
+
+def test_prove_verify_roundtrip(params, rng):
+    prover, proof = make_proof(params, rng)
+    Verifier(params, prover.statement).verify(proof)
+
+
+def test_verify_rejects_wrong_statement(params, rng):
+    prover, proof = make_proof(params, rng)
+    other = Statement.from_witness(params, Witness(Ristretto255.random_scalar(rng)))
+    with pytest.raises(InvalidParams):
+        Verifier(params, other).verify(proof)
+
+
+def test_interactive_protocol(params, rng):
+    x = Ristretto255.random_scalar(rng)
+    prover = Prover(params, Witness(x))
+    commitment, nonce = prover.commit(rng)
+    challenge = Ristretto255.random_scalar(rng)
+    response = prover.respond(nonce, challenge)
+    proof = Proof(commitment, response)
+    Verifier(params, prover.statement).verify_response(challenge, proof)
+    # wrong challenge fails
+    with pytest.raises(InvalidParams):
+        Verifier(params, prover.statement).verify_response(
+            Ristretto255.random_scalar(rng), proof
+        )
+
+
+def test_proof_context_binding(params, rng):
+    """Context replay rejection (security_tests.rs:5-39)."""
+    x = Ristretto255.random_scalar(rng)
+    prover = Prover(params, Witness(x))
+    t = Transcript()
+    t.append_context(b"challenge-id-1")
+    proof = prover.prove_with_transcript(rng, t)
+
+    ok = Transcript()
+    ok.append_context(b"challenge-id-1")
+    Verifier(params, prover.statement).verify_with_transcript(proof, ok)
+
+    replay = Transcript()
+    replay.append_context(b"challenge-id-2")
+    with pytest.raises(InvalidParams):
+        Verifier(params, prover.statement).verify_with_transcript(proof, replay)
+
+
+def test_proofs_are_randomized(params, rng):
+    """Proof uniqueness (security_tests.rs:165-209)."""
+    x = Ristretto255.random_scalar(rng)
+    prover = Prover(params, Witness(x))
+    p1 = prover.prove(rng)
+    p2 = prover.prove(rng)
+    assert p1.to_bytes() != p2.to_bytes()
+    v = Verifier(params, prover.statement)
+    v.verify(p1)
+    v.verify(p2)
+
+
+def test_corrupted_proof_bytes_reject(params, rng):
+    """Bit-flip corruption (security_tests.rs:41-105): every single-bit flip
+    either fails to parse or fails verification."""
+    prover, proof = make_proof(params, rng)
+    verifier = Verifier(params, prover.statement)
+    data = bytearray(proof.to_bytes())
+    # flip one bit in r1, one in r2, one in s
+    for pos in (10, 46, 108):
+        corrupted = bytearray(data)
+        corrupted[pos] ^= 0x40
+        try:
+            parsed = Proof.from_bytes(bytes(corrupted))
+        except Exception:
+            continue
+        with pytest.raises(InvalidParams):
+            verifier.verify(parsed)

@@ -1,0 +1,113 @@
+"""Ristretto255 group tests against RFC 9496 vectors + reference API parity
+(mirrors the inline tests in reference src/primitives/ristretto.rs:224-329)."""
+
+import pytest
+
+from cpzk_tpu.core import edwards
+from cpzk_tpu.core.ristretto import Element, Ristretto255, Scalar
+from cpzk_tpu.core.rng import SecureRng
+from cpzk_tpu.errors import InvalidGroupElement, InvalidScalar
+
+# RFC 9496 appendix A: first multiples of the ristretto255 generator.
+SMALL_MULTIPLES = [
+    "0000000000000000000000000000000000000000000000000000000000000000",
+    "e2f2ae0a6abc4e71a884a961c500515f58e30b6aa582dd8db6a65945e08d2d76",
+    "6a493210f7499cd17fecb510ae0cea23a110e8d5b901f8acadd3095c73a3b919",
+    "94741f5d5d52755ece4f23f044ee27d5d1ea1e2bd196b462166b16152a9d0259",
+    "da80862773358b466ffadfe0b3293ab3d9fd53c5ea6c955358f568322daf6a57",
+]
+
+
+def test_small_multiples():
+    acc = edwards.IDENTITY
+    for expected in SMALL_MULTIPLES:
+        assert edwards.ristretto_encode(acc).hex() == expected
+        acc = edwards.pt_add(acc, edwards.BASEPOINT)
+
+
+def test_one_way_map_vector():
+    """RFC 9496 one-way map vector (dalek/libsodium 'espresso' vector) —
+    guards the sign of SQRT_AD_MINUS_ONE, which the squaring-only constant
+    test cannot see."""
+    import hashlib
+
+    digest = hashlib.sha512(
+        b"Ristretto is traditionally a short shot of espresso coffee"
+    ).digest()
+    point = edwards.ristretto_from_uniform_bytes(digest)
+    assert (
+        edwards.ristretto_encode(point).hex()
+        == "3066f82a1a747d45120d1740f14358531a8f04bbffe6a819f86dfe50f44a0a46"
+    )
+
+
+def test_decode_rejects_noncanonical():
+    # s >= p
+    bad = (edwards.P + 2).to_bytes(32, "little")
+    assert edwards.ristretto_decode(bad) is None
+    # negative (odd) s
+    assert edwards.ristretto_decode((3).to_bytes(32, "little")) is None
+    # all-ones
+    assert edwards.ristretto_decode(b"\xff" * 32) is None
+    # wrong length via API
+    with pytest.raises(InvalidGroupElement):
+        Ristretto255.element_from_bytes(b"\x00" * 31)
+
+
+def test_generators_distinct_and_valid():
+    g = Ristretto255.generator_g()
+    h = Ristretto255.generator_h()
+    assert g != h
+    assert not Ristretto255.is_identity(g)
+    assert not Ristretto255.is_identity(h)
+    Ristretto255.validate_element(g)
+    Ristretto255.validate_element(h)
+    # deterministic
+    assert Ristretto255.element_to_bytes(h) == Ristretto255.element_to_bytes(Ristretto255.generator_h())
+
+
+def test_scalar_roundtrip_and_ops():
+    rng = SecureRng()
+    a = Ristretto255.random_scalar(rng)
+    b = Ristretto255.random_scalar(rng)
+    assert Ristretto255.scalar_sub(Ristretto255.scalar_add(a, b), b) == a
+    assert Ristretto255.scalar_mul_scalar(a, b) == Ristretto255.scalar_mul_scalar(b, a)
+    inv = Ristretto255.scalar_invert(a)
+    assert Ristretto255.scalar_mul_scalar(a, inv) == Scalar(1)
+    assert Ristretto255.scalar_invert(Scalar(0)) is None
+    data = Ristretto255.scalar_to_bytes(a)
+    assert Ristretto255.scalar_from_bytes(data) == a
+    with pytest.raises(InvalidScalar):
+        Ristretto255.scalar_from_bytes(b"\xff" * 32)
+
+
+def test_element_roundtrip_and_group_law():
+    rng = SecureRng()
+    g = Ristretto255.generator_g()
+    a = Ristretto255.random_scalar(rng)
+    b = Ristretto255.random_scalar(rng)
+    ga = Ristretto255.scalar_mul(g, a)
+    gb = Ristretto255.scalar_mul(g, b)
+    # serialization roundtrip
+    data = Ristretto255.element_to_bytes(ga)
+    assert Ristretto255.element_from_bytes(data) == ga
+    # homomorphism: g^a * g^b == g^(a+b)
+    lhs = Ristretto255.element_mul(ga, gb)
+    rhs = Ristretto255.scalar_mul(g, Ristretto255.scalar_add(a, b))
+    assert lhs == rhs
+    Ristretto255.validate_element(ga)
+
+
+def test_identity():
+    ident = Ristretto255.identity()
+    assert Ristretto255.is_identity(ident)
+    assert not Ristretto255.is_identity(Ristretto255.generator_g())
+    assert Ristretto255.element_to_bytes(ident) == b"\x00" * 32
+    Ristretto255.validate_element(ident)
+
+
+def test_torsion_coset_equality():
+    # The 2-torsion point (0, -1) is in the identity coset.
+    t = (0, edwards.P - 1, 1, 0)
+    assert Element(t) == Ristretto255.identity()
+    assert edwards.ristretto_encode(t) == b"\x00" * 32
