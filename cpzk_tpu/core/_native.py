@@ -1,0 +1,134 @@
+"""ctypes binding for the C++ host crypto core (native/merlin.cpp).
+
+Loads ``cpzk_tpu/_lib/libcpzk_native.so``, building it on first import when
+missing and a C++ toolchain is available. Every consumer falls back to the
+pure-Python twins when the library cannot be loaded — the native core is an
+accelerator, never a requirement (SURVEY.md §2.2 rebuild strategy).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+
+_LIB_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "_lib")
+_LIB_PATH = os.path.join(_LIB_DIR, "libcpzk_native.so")
+_SRC_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "native")
+
+_lib: ctypes.CDLL | None = None
+_tried = False
+
+
+def _build() -> bool:
+    if os.environ.get("CPZK_NO_NATIVE_BUILD"):
+        return False
+    try:
+        subprocess.run(
+            ["make", "-s"],
+            cwd=_SRC_DIR,
+            check=True,
+            capture_output=True,
+            timeout=120,
+        )
+        return os.path.exists(_LIB_PATH)
+    except Exception:
+        return False
+
+
+def load() -> ctypes.CDLL | None:
+    """The native library, or None when unavailable."""
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    _tried = True
+    if not os.path.exists(_LIB_PATH) and not _build():
+        return None
+    try:
+        lib = ctypes.CDLL(_LIB_PATH)
+    except OSError:
+        return None
+
+    lib.cpzk_transcript_new.restype = ctypes.c_void_p
+    lib.cpzk_transcript_new.argtypes = [ctypes.c_char_p, ctypes.c_size_t]
+    lib.cpzk_transcript_free.argtypes = [ctypes.c_void_p]
+    lib.cpzk_transcript_append.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_size_t,
+        ctypes.c_char_p, ctypes.c_size_t,
+    ]
+    lib.cpzk_transcript_challenge.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_size_t,
+        ctypes.c_char_p, ctypes.c_size_t,
+    ]
+    lib.cpzk_challenge_batch.argtypes = [
+        ctypes.c_size_t, ctypes.c_char_p, ctypes.POINTER(ctypes.c_uint32),
+        ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p,
+        ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p,
+        ctypes.c_int,
+    ]
+    _lib = lib
+    return _lib
+
+
+def challenge_batch(
+    contexts: list[bytes | None],
+    gs: bytes,
+    hs: bytes,
+    y1s: bytes,
+    y2s: bytes,
+    r1s: bytes,
+    r2s: bytes,
+    threads: int = 0,
+) -> bytes | None:
+    """Derive n 64-byte challenges natively; None if the library is absent.
+
+    Point args are n*32-byte concatenations; ``contexts[i] is None`` means
+    "no context append" for row i (distinct from ``b""``).
+    """
+    lib = load()
+    if lib is None:
+        return None
+    n = len(contexts)
+    for name, col in (("gs", gs), ("hs", hs), ("y1s", y1s), ("y2s", y2s), ("r1s", r1s), ("r2s", r2s)):
+        if len(col) != 32 * n:
+            raise ValueError(f"{name} must be {32 * n} bytes (n*32), got {len(col)}")
+    has_ctx = bytes(0 if c is None else 1 for c in contexts)
+    blob = b"".join(c or b"" for c in contexts)
+    offsets = (ctypes.c_uint32 * (n + 1))()
+    off = 0
+    for i, c in enumerate(contexts):
+        offsets[i] = off
+        off += len(c or b"")
+    offsets[n] = off
+    out = ctypes.create_string_buffer(64 * n)
+    lib.cpzk_challenge_batch(
+        n, blob, offsets, has_ctx, gs, hs, y1s, y2s, r1s, r2s, out, threads
+    )
+    return out.raw
+
+
+class NativeMerlin:
+    """Incremental Merlin transcript over the native core (Strobe128 twin)."""
+
+    __slots__ = ("_h", "_lib")
+
+    def __init__(self, label: bytes):
+        lib = load()
+        if lib is None:
+            raise RuntimeError("native core unavailable")
+        self._lib = lib
+        self._h = lib.cpzk_transcript_new(label, len(label))
+
+    def append_message(self, label: bytes, message: bytes) -> None:
+        self._lib.cpzk_transcript_append(self._h, label, len(label), message, len(message))
+
+    def challenge_bytes(self, label: bytes, n: int) -> bytes:
+        out = ctypes.create_string_buffer(n)
+        self._lib.cpzk_transcript_challenge(self._h, label, len(label), out, n)
+        return out.raw
+
+    def __del__(self):
+        try:
+            self._lib.cpzk_transcript_free(self._h)
+        except Exception:
+            pass

@@ -15,6 +15,7 @@ The protocol-level labels and append order mirror the reference
 challenge DST ``"challenge"``, and the 64-byte wide challenge reduction.
 """
 
+from . import _native
 from .scalars import sc_from_bytes_mod_order_wide
 from .strobe import Strobe128
 
@@ -55,7 +56,12 @@ class Transcript:
     """
 
     def __init__(self) -> None:
-        self._t = MerlinTranscript(PROTOCOL_LABEL)
+        # native C++ core when built (byte-identical; tests/test_native.py),
+        # pure-Python twin otherwise
+        if _native.load() is not None:
+            self._t = _native.NativeMerlin(PROTOCOL_LABEL)
+        else:
+            self._t = MerlinTranscript(PROTOCOL_LABEL)
         self._t.append_message(b"protocol", PROTOCOL_DST)
 
     def append_context(self, context: bytes) -> None:
@@ -78,3 +84,44 @@ class Transcript:
 
         buf = self._t.challenge_bytes(CHALLENGE_DST, WIDE_REDUCTION_BYTES)
         return Scalar(sc_from_bytes_mod_order_wide(buf))
+
+
+def derive_challenges_batch(
+    contexts: list[bytes | None],
+    gs: list[bytes],
+    hs: list[bytes],
+    y1s: list[bytes],
+    y2s: list[bytes],
+    r1s: list[bytes],
+    r2s: list[bytes],
+):
+    """Fiat-Shamir challenges for a whole batch (host hot loop of batch
+    verification; reference analog ``src/verifier/batch.rs:239-260``).
+
+    Uses the threaded C++ core when available, else per-row Python
+    transcripts. Returns a list of Scalars.
+    """
+    from .ristretto import Scalar
+
+    out = _native.challenge_batch(
+        contexts,
+        b"".join(gs), b"".join(hs),
+        b"".join(y1s), b"".join(y2s),
+        b"".join(r1s), b"".join(r2s),
+    )
+    if out is not None:
+        return [
+            Scalar(sc_from_bytes_mod_order_wide(out[64 * i : 64 * i + 64]))
+            for i in range(len(contexts))
+        ]
+
+    scalars = []
+    for i in range(len(contexts)):
+        t = Transcript()
+        if contexts[i] is not None:
+            t.append_context(contexts[i])
+        t.append_parameters(gs[i], hs[i])
+        t.append_statement(y1s[i], y2s[i])
+        t.append_commitment(r1s[i], r2s[i])
+        scalars.append(t.challenge_scalar())
+    return scalars

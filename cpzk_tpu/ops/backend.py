@@ -1,0 +1,131 @@
+"""TPU/JAX ``VerifierBackend`` — the device data plane behind
+:class:`cpzk_tpu.protocol.batch.BatchVerifier`.
+
+Host side: scalar arithmetic mod l (Python ints are exact and cheap relative
+to group ops), 4-bit window decomposition, and SoA limb marshalling of the
+row points.  Device side: the batched kernels in :mod:`cpzk_tpu.ops.verify`.
+Batch shapes are padded to powers of two so ``jax.jit`` caches a handful of
+programs instead of one per batch size.
+
+Semantics parity (reference ``src/verifier/batch.rs``): the combined check
+is only an accelerator — on failure ``BatchVerifier`` falls back to
+``verify_each``, whose per-row results are ground truth, so accept/reject
+matches the reference bit-for-bit (SURVEY.md §3.2).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core import edwards
+from ..core.ristretto import Ristretto255, Scalar
+from ..core.scalars import L
+from ..protocol.batch import BatchRow, VerifierBackend
+from . import curve, verify
+
+
+def _pad_pow2(n: int) -> int:
+    size = 1
+    while size < n:
+        size *= 2
+    return size
+
+
+def _points_soa(points: list[edwards.Point], pad: int) -> curve.Point:
+    pts = points + [edwards.IDENTITY] * (pad - len(points))
+    return curve.points_to_device(pts)
+
+
+def _windows(values: list[int], pad: int) -> jnp.ndarray:
+    vals = values + [0] * (pad - len(values))
+    return jnp.asarray(curve.scalars_to_windows(vals))
+
+
+@partial(jax.jit, static_argnums=(0,))
+def _each_shared(n_pad, g, h, y1, y2, r1, r2, ws, wc):
+    del n_pad  # static cache key only
+    return verify.verify_each_kernel(g, h, y1, y2, r1, r2, ws, wc)
+
+
+@partial(jax.jit, static_argnums=(0,))
+def _combined(n_pad, r1, y1, r2, y2, w_a, w_ac, w_ba, w_bac):
+    del n_pad
+    return verify.combined_kernel(r1, y1, r2, y2, w_a, w_ac, w_ba, w_bac)
+
+
+class TpuBackend(VerifierBackend):
+    """Vectorized device backend (TPU when available, any JAX backend)."""
+
+    prefers_combined = True
+
+    def __init__(self):
+        self._gh_cache: dict[tuple[bytes, bytes], tuple[curve.Point, curve.Point]] = {}
+
+    def _gh(self, row: BatchRow) -> tuple[curve.Point, curve.Point]:
+        key = (
+            Ristretto255.element_to_bytes(row.g),
+            Ristretto255.element_to_bytes(row.h),
+        )
+        if key not in self._gh_cache:
+            self._gh_cache[key] = (
+                curve.points_to_device([row.g.point]),
+                curve.points_to_device([row.h.point]),
+            )
+            # single-point tables: squeeze the batch axis -> [20] coords
+            self._gh_cache[key] = tuple(
+                tuple(c[0] for c in pt) for pt in self._gh_cache[key]
+            )
+        return self._gh_cache[key]
+
+    # -- VerifierBackend interface ------------------------------------------
+
+    def verify_combined(self, rows: list[BatchRow], beta: Scalar) -> bool:
+        n = len(rows)
+        b = beta.value
+        a = [r.alpha.value for r in rows]
+        c = [r.c.value for r in rows]
+        s = [r.s.value for r in rows]
+        ac = [x * y % L for x, y in zip(a, c)]
+        ba = [b * x % L for x in a]
+        bac = [b * x % L for x in ac]
+        sum_as = sum(x * y for x, y in zip(a, s)) % L
+
+        # correction row: G in slot r1 with -sum(a s), H in slot y1 with
+        # -b sum(a s); identity in the other two slots.
+        g, h = rows[0].g.point, rows[0].h.point
+        pad = _pad_pow2(n + 1)
+        r1 = _points_soa([r.r1.point for r in rows] + [g], pad)
+        y1 = _points_soa([r.y1.point for r in rows] + [h], pad)
+        r2 = _points_soa([r.r2.point for r in rows], pad)
+        y2 = _points_soa([r.y2.point for r in rows], pad)
+        w_a = _windows(a + [(L - sum_as) % L], pad)
+        w_ac = _windows(ac + [(L - b * sum_as % L) % L], pad)
+        w_ba = _windows(ba, pad)
+        w_bac = _windows(bac, pad)
+
+        ok = _combined(pad, r1, y1, r2, y2, w_a, w_ac, w_ba, w_bac)
+        return bool(ok)
+
+    def verify_each(self, rows: list[BatchRow]) -> list[bool]:
+        n = len(rows)
+        pad = _pad_pow2(n)
+        shared = all(r.g == rows[0].g and r.h == rows[0].h for r in rows)
+        if shared:
+            g, h = self._gh(rows[0])
+        else:
+            g = _points_soa([r.g.point for r in rows], pad)
+            h = _points_soa([r.h.point for r in rows], pad)
+        y1 = _points_soa([r.y1.point for r in rows], pad)
+        y2 = _points_soa([r.y2.point for r in rows], pad)
+        r1 = _points_soa([r.r1.point for r in rows], pad)
+        r2 = _points_soa([r.r2.point for r in rows], pad)
+        ws = _windows([r.s.value for r in rows], pad)
+        wc = _windows([r.c.value for r in rows], pad)
+
+        mask = _each_shared(pad, g, h, y1, y2, r1, r2, ws, wc)
+        return [bool(v) for v in np.asarray(mask)[:n]]

@@ -1,0 +1,269 @@
+"""Batched edwards25519 / ristretto255 point kernels (JAX).
+
+Points are structure-of-arrays extended coordinates: a tuple
+``(X, Y, Z, T)`` of ``[..., 20]`` int32 limb arrays (x = X/Z, y = Y/Z,
+T = XY/Z).  Everything is batched over leading axes and shardable along
+them; no data-dependent control flow (masks/selects only), so the whole
+thing stays inside one XLA program.
+
+Re-design (not a port) of the point layer that curve25519-dalek provides
+under the reference's ``src/primitives/ristretto.rs`` (SURVEY.md §2.2):
+
+- unified add / double (HWCD'08 a=-1 formulas, same as the host twin
+  :mod:`cpzk_tpu.core.edwards`)
+- on-device ristretto DECODE (RFC 9496 §4.3.1) from wire bytes, returning a
+  validity mask instead of raising — the adversarial checks of
+  ``ristretto.rs:120-138`` become lane masks
+- on-device ENCODE (RFC 9496 §4.3.2) for compressed output
+- windowed (4-bit) double-and-add scalar multiplication with per-lane
+  precomputed tables — scalars are public verification inputs here
+  (vartime is fine; see docs/security.md)
+- batch tree-reduction point sum for the combined RLC check
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+from jax import lax
+
+from ..core import edwards as host_edwards
+from . import limbs
+from .limbs import NLIMBS
+
+Point = tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]
+
+WINDOW_BITS = 4
+NWINDOWS = 64  # ceil(253 / 4) -> 64 windows cover 256 bits
+TABLE = 1 << WINDOW_BITS
+
+
+# ---------------------------------------------------------------------------
+# host <-> device marshalling
+# ---------------------------------------------------------------------------
+
+def points_to_device(points: list[host_edwards.Point]) -> Point:
+    """Host extended-coordinate points -> SoA limb arrays [n, 20] x 4."""
+    xs = limbs.ints_to_limbs([p[0] for p in points])
+    ys = limbs.ints_to_limbs([p[1] for p in points])
+    zs = limbs.ints_to_limbs([p[2] for p in points])
+    ts = limbs.ints_to_limbs([p[3] for p in points])
+    return (jnp.asarray(xs), jnp.asarray(ys), jnp.asarray(zs), jnp.asarray(ts))
+
+
+def points_from_device(pt: Point) -> list[host_edwards.Point]:
+    coords = [limbs.limbs_to_ints(np.asarray(c)) for c in pt]
+    return list(zip(*coords))
+
+
+def identity(shape: tuple[int, ...] = ()) -> Point:
+    z = jnp.zeros(shape + (NLIMBS,), dtype=jnp.int32)
+    one = jnp.broadcast_to(limbs.ONE, shape + (NLIMBS,))
+    return (z, one, one, z)
+
+
+# ---------------------------------------------------------------------------
+# group operations
+# ---------------------------------------------------------------------------
+
+def add(p: Point, q: Point) -> Point:
+    """Unified a=-1 extended addition (add-2008-hwcd-3); twin of
+    ``core.edwards.pt_add``."""
+    X1, Y1, Z1, T1 = p
+    X2, Y2, Z2, T2 = q
+    A = limbs.mul(limbs.sub(Y1, X1), limbs.sub(Y2, X2))
+    B = limbs.mul(limbs.add(Y1, X1), limbs.add(Y2, X2))
+    C = limbs.mul(limbs.mul(T1, limbs.D2), T2)
+    Dv = limbs.mul_small(limbs.mul(Z1, Z2), 2)
+    E = limbs.sub(B, A)
+    F = limbs.sub(Dv, C)
+    G = limbs.add(Dv, C)
+    H = limbs.add(B, A)
+    return (limbs.mul(E, F), limbs.mul(G, H), limbs.mul(F, G), limbs.mul(E, H))
+
+
+def double(p: Point) -> Point:
+    """a=-1 doubling (dbl-2008-hwcd); twin of ``core.edwards.pt_double``."""
+    X1, Y1, Z1, _ = p
+    A = limbs.square(X1)
+    B = limbs.square(Y1)
+    C = limbs.mul_small(limbs.square(Z1), 2)
+    H = limbs.add(A, B)
+    E = limbs.sub(H, limbs.square(limbs.add(X1, Y1)))
+    G = limbs.sub(A, B)
+    F = limbs.add(C, G)
+    return (limbs.mul(E, F), limbs.mul(G, H), limbs.mul(F, G), limbs.mul(E, H))
+
+
+def negate(p: Point) -> Point:
+    X, Y, Z, T = p
+    return (limbs.neg(X), Y, Z, limbs.neg(T))
+
+
+def select(mask: jnp.ndarray, p: Point, q: Point) -> Point:
+    """Lane-wise where(mask, p, q); mask shaped [...] (no limb axis)."""
+    return tuple(limbs.select(mask, a, b) for a, b in zip(p, q))
+
+
+def eq(p: Point, q: Point) -> jnp.ndarray:
+    """Ristretto (quotient-group) equality: X1*Y2 == Y1*X2 or
+    Y1*Y2 == X1*X2 — twin of ``core.edwards.pt_eq``."""
+    X1, Y1, _, _ = p
+    X2, Y2, _, _ = q
+    a = limbs.eq(limbs.mul(X1, Y2), limbs.mul(Y1, X2))
+    b = limbs.eq(limbs.mul(Y1, Y2), limbs.mul(X1, X2))
+    return a | b
+
+
+def is_identity(p: Point) -> jnp.ndarray:
+    """Identity test in the quotient group: X == 0 or Y == 0 (the identity
+    coset {(0,±1),(±i,0)} is exactly X*Y == 0 among valid points)."""
+    X, Y, _, _ = p
+    return limbs.is_zero(X) | limbs.is_zero(Y)
+
+
+# ---------------------------------------------------------------------------
+# scalar multiplication
+# ---------------------------------------------------------------------------
+
+def scalars_to_windows(values: list[int]) -> np.ndarray:
+    """Host: scalars (already reduced mod l) -> [n, 64] int32 of 4-bit
+    windows, most-significant window first."""
+    blob = b"".join(int(v).to_bytes(32, "little") for v in values)
+    raw = np.frombuffer(blob, dtype=np.uint8).reshape(len(values), 32)
+    lo = raw & 0x0F
+    hi = raw >> 4
+    nibbles = np.empty((len(values), NWINDOWS), dtype=np.int32)
+    nibbles[:, 0::2] = lo
+    nibbles[:, 1::2] = hi
+    return nibbles[:, ::-1]  # MSB window first
+
+
+def _table_gather(table: tuple[jnp.ndarray, ...], idx: jnp.ndarray) -> Point:
+    """table coords are [..., TABLE, 20]; idx is [...] -> Point [..., 20]."""
+    idxe = idx[..., None, None]
+    return tuple(
+        jnp.take_along_axis(c, jnp.broadcast_to(idxe, idx.shape + (1, NLIMBS)), axis=-2)[
+            ..., 0, :
+        ]
+        for c in table
+    )
+
+
+def scalar_mul(p: Point, windows: jnp.ndarray) -> Point:
+    """Batched windowed double-and-add: [..., 20]-point ** [..., 64]-windows.
+
+    Per lane: precompute table [0..15]*P (15 batched adds), then 64 steps of
+    4 doublings + one gathered table add.  ~255 doubles + 79 adds per lane,
+    fully vectorized across the batch; variable-base, variable-time in the
+    *public* scalar only (verification inputs).
+    """
+    # table[k] = k * P, coords stacked on axis -2: [..., 16, 20]
+    tbl = [identity(windows.shape[:-1]), p]
+    for _ in range(TABLE - 2):
+        tbl.append(add(tbl[-1], p))
+    table = tuple(
+        jnp.stack([t[i] for t in tbl], axis=-2) for i in range(4)
+    )
+
+    def step(acc: Point, w: jnp.ndarray) -> tuple[Point, None]:
+        for _ in range(WINDOW_BITS):
+            acc = double(acc)
+        return add(acc, _table_gather(table, w)), None
+
+    # scan over the window axis (time-major): move windows to axis 0
+    wT = jnp.moveaxis(windows, -1, 0)  # [64, ...]
+    acc0 = identity(windows.shape[:-1])
+    acc, _ = lax.scan(lambda a, w: step(a, w), acc0, wT)
+    return acc
+
+
+def tree_sum(p: Point, axis: int = 0) -> Point:
+    """Reduce-sum of points along ``axis`` by halving (log2 n batched adds).
+
+    Pads to a power of two with identity points.
+    """
+    n = p[0].shape[axis]
+    coords = [jnp.moveaxis(c, axis, 0) for c in p]
+    size = 1
+    while size < n:
+        size *= 2
+    if size != n:
+        pad = identity((size - n,) + coords[0].shape[1:-1])
+        coords = [jnp.concatenate([c, pc], axis=0) for c, pc in zip(coords, pad)]
+    pt = tuple(coords)
+    while pt[0].shape[0] > 1:
+        half = pt[0].shape[0] // 2
+        a = tuple(c[:half] for c in pt)
+        b = tuple(c[half:] for c in pt)
+        pt = add(a, b)
+    return tuple(c[0] for c in pt)
+
+
+# ---------------------------------------------------------------------------
+# ristretto decode / encode (device-side, batched)
+# ---------------------------------------------------------------------------
+
+def decode(wire: jnp.ndarray) -> tuple[Point, jnp.ndarray]:
+    """RFC 9496 DECODE on [..., 32] byte arrays.
+
+    Returns (point, valid_mask). Invalid lanes yield the identity point with
+    ``valid == False`` — the reference's error returns
+    (``ristretto.rs:120-138``) become mask bits the caller folds into its
+    accept/reject output.
+    """
+    b = wire.astype(jnp.int32)
+    s = limbs.from_bytes_le(b)
+    # canonical check: re-encoding must reproduce the input bytes
+    canonical_ok = jnp.all(limbs.to_bytes_le(s) == b, axis=-1)
+    even_ok = (b[..., 0] & 1) == 0
+
+    ss = limbs.square(s)
+    u1 = limbs.sub(limbs.ONE, ss)
+    u2 = limbs.add(limbs.ONE, ss)
+    u2_sqr = limbs.square(u2)
+    v = limbs.sub(limbs.neg(limbs.mul(limbs.D, limbs.square(u1))), u2_sqr)
+    was_square, invsqrt = limbs.sqrt_ratio_m1(limbs.ONE, limbs.mul(v, u2_sqr))
+    den_x = limbs.mul(invsqrt, u2)
+    den_y = limbs.mul(limbs.mul(invsqrt, den_x), v)
+    x = limbs.fabs(limbs.mul(limbs.mul_small(s, 2), den_x))
+    y = limbs.mul(u1, den_y)
+    t = limbs.mul(x, y)
+
+    valid = (
+        canonical_ok
+        & even_ok
+        & was_square
+        & ~limbs.is_negative(t)
+        & ~limbs.is_zero(y)
+    )
+    one = jnp.broadcast_to(limbs.ONE, x.shape)
+    zero = jnp.zeros_like(x)
+    pt = select(valid, (x, y, one, t), (zero, one, one, zero))
+    return pt, valid
+
+
+def encode(p: Point) -> jnp.ndarray:
+    """RFC 9496 ENCODE -> [..., 32] int32 byte values; twin of
+    ``core.edwards.ristretto_encode``."""
+    X0, Y0, Z0, T0 = p
+    u1 = limbs.mul(limbs.add(Z0, Y0), limbs.sub(Z0, Y0))
+    u2 = limbs.mul(X0, Y0)
+    _, invsqrt = limbs.sqrt_ratio_m1(limbs.ONE, limbs.mul(u1, limbs.square(u2)))
+    den1 = limbs.mul(invsqrt, u1)
+    den2 = limbs.mul(invsqrt, u2)
+    z_inv = limbs.mul(limbs.mul(den1, den2), T0)
+
+    ix0 = limbs.mul(X0, limbs.SQRT_M1)
+    iy0 = limbs.mul(Y0, limbs.SQRT_M1)
+    enchanted = limbs.mul(den1, limbs.INVSQRT_A_MINUS_D)
+    rotate = limbs.is_negative(limbs.mul(T0, z_inv))
+
+    x = limbs.select(rotate, iy0, X0)
+    y = limbs.select(rotate, ix0, Y0)
+    den_inv = limbs.select(rotate, enchanted, den2)
+
+    y = limbs.select(limbs.is_negative(limbs.mul(x, z_inv)), limbs.neg(y), y)
+    s = limbs.fabs(limbs.mul(den_inv, limbs.sub(Z0, y)))
+    return limbs.to_bytes_le(s)

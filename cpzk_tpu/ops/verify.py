@@ -1,0 +1,117 @@
+"""Batched Chaum-Pedersen verification kernels (JAX).
+
+Two device programs, both free of data-dependent control flow:
+
+- ``verify_each_kernel`` — per-proof ground truth. For each row checks
+  ``s*G - c*y1 - r1 == O`` and ``s*H - c*y2 - r2 == O`` (the additive form
+  of the reference's ``g^s == r1 * y1^c`` check, ``verifier/mod.rs:144-171``)
+  using a *shared-doubling* double-scalar chain per equation: one 255-double
+  ladder with two 4-bit window tables instead of two independent scalar
+  multiplications.
+
+- ``combined_kernel`` — the corrected randomized-linear-combination batch
+  check (SURVEY.md §3.2; the reference's own equation at ``batch.rs:292-308``
+  drops the alpha coefficient on the ``y^c`` term and always falls back).
+  Per row computes ``a*r1 + (a*c)*y1 + (b*a)*r2 + (b*a*c)*y2`` with one
+  shared-doubling chain and four tables, tree-sums all rows plus one
+  host-built correction row carrying ``(-sum a*s)*G + (-b*sum a*s)*H``, and
+  accepts iff the total is the identity coset.
+
+Scalar decomposition (mod l) happens on the host; the device sees only
+public 4-bit windows — verification inputs are public, so vartime gathers
+are fine (docs/security.md).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from . import curve, limbs
+from .curve import NWINDOWS, Point, TABLE, WINDOW_BITS
+
+
+def build_table(p: Point) -> tuple[jnp.ndarray, ...]:
+    """[0..15] * p, coords stacked on axis -2 -> 4 x [..., 16, 20]."""
+    tbl = [curve.identity(p[0].shape[:-1]), p]
+    for _ in range(TABLE - 2):
+        tbl.append(curve.add(tbl[-1], p))
+    return tuple(jnp.stack([t[i] for t in tbl], axis=-2) for i in range(4))
+
+
+def _gather(table: tuple[jnp.ndarray, ...], idx: jnp.ndarray) -> Point:
+    if table[0].ndim == 2:  # shared (unbatched) table: [16, 20]
+        return tuple(jnp.take(c, idx, axis=0) for c in table)
+    return curve._table_gather(table, idx)
+
+
+def _msm_rows(tables: list[tuple[jnp.ndarray, ...]], windows: list[jnp.ndarray]) -> Point:
+    """Shared-doubling multi-term scalar-mul.
+
+    ``tables[k]`` is the window table of point set k (coords [..., 16, 20] or
+    broadcastable), ``windows[k]`` its [..., 64] window array (MSB first).
+    Returns sum_k scalar_k * point_k per lane: one doubling ladder total.
+    """
+    shape = windows[0].shape[:-1]
+    wT = jnp.stack([jnp.moveaxis(w, -1, 0) for w in windows], axis=1)  # [64, K, ...]
+
+    def step(acc: Point, w):
+        for _ in range(WINDOW_BITS):
+            acc = curve.double(acc)
+        for k, table in enumerate(tables):
+            acc = curve.add(acc, _gather(table, w[k]))
+        return acc, None
+
+    acc, _ = lax.scan(step, curve.identity(shape), wT)
+    return acc
+
+
+def verify_each_kernel(
+    g: Point,
+    h: Point,
+    y1: Point,
+    y2: Point,
+    r1: Point,
+    r2: Point,
+    ws: jnp.ndarray,
+    wc: jnp.ndarray,
+) -> jnp.ndarray:
+    """Per-proof checks -> [n] bool.
+
+    ``g``/``h`` are single (unbatched) points; ``y*``/``r*`` are [n]-batched;
+    ``ws``/``wc`` are [n, 64] windows of s and c.
+    """
+    tg = build_table(g)     # [16, 20] coords, broadcast-gathered per lane
+    th = build_table(h)
+    tny1 = build_table(curve.negate(y1))
+    tny2 = build_table(curve.negate(y2))
+
+    d1 = _msm_rows([tg, tny1], [ws, wc])
+    d2 = _msm_rows([th, tny2], [ws, wc])
+    d1 = curve.add(d1, curve.negate(r1))
+    d2 = curve.add(d2, curve.negate(r2))
+    return curve.is_identity(d1) & curve.is_identity(d2)
+
+
+def combined_kernel(
+    r1: Point,
+    y1: Point,
+    r2: Point,
+    y2: Point,
+    w_a: jnp.ndarray,
+    w_ac: jnp.ndarray,
+    w_ba: jnp.ndarray,
+    w_bac: jnp.ndarray,
+) -> jnp.ndarray:
+    """Corrected-RLC combined check -> scalar bool.
+
+    Callers append the correction row (points G, H, O, O with windows of
+    ``-sum(a*s)``, ``-b*sum(a*s)``, 0, 0) before invoking, so acceptance is
+    ``total == O``.
+    """
+    rows = _msm_rows(
+        [build_table(r1), build_table(y1), build_table(r2), build_table(y2)],
+        [w_a, w_ac, w_ba, w_bac],
+    )
+    total = curve.tree_sum(rows, axis=0)
+    return curve.is_identity(total)

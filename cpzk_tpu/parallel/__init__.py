@@ -1,0 +1,12 @@
+"""Multi-chip parallelism: mesh construction and sharded batch verification.
+
+The reference is a single-process CPU program (SURVEY.md §2.3); its only
+scaling axis is proof-batch size. The TPU-native analog shards that batch
+axis across a ``jax.sharding.Mesh`` — per-chip partial work runs locally,
+and the combined-check reduction rides ICI collectives (``psum`` under
+``shard_map``), never DCN, matching the scaling-book recipe.
+"""
+
+from .mesh import batch_mesh, sharded_combined_check, sharded_verify_each
+
+__all__ = ["batch_mesh", "sharded_combined_check", "sharded_verify_each"]

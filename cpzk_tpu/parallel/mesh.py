@@ -1,0 +1,110 @@
+"""Mesh-sharded batch verification (shard_map + ICI collectives).
+
+Design (SURVEY.md §2.3, §5 long-context entry): proofs are embarrassingly
+parallel along the batch axis, so every row array (`[n, ...]` points and
+`[n, 64]` scalar windows) is sharded over a 1-D device mesh. The per-proof
+kernel needs no communication at all; the combined RLC check reduces each
+device's shard to one partial point locally, then combines the ``D`` partial
+points with one tiny cross-device gather — the multi-chip analog of the
+reference's accumulation loop at ``src/verifier/batch.rs:271-312``.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.experimental.shard_map import shard_map
+
+from ..ops import curve, verify
+
+AXIS = "batch"
+
+
+def batch_mesh(devices=None) -> Mesh:
+    """1-D data-parallel mesh over all (or the given) devices."""
+    if devices is None:
+        devices = jax.devices()
+    import numpy as np
+
+    return Mesh(np.asarray(devices), (AXIS,))
+
+
+def _point_specs(spec):
+    return (spec, spec, spec, spec)
+
+
+def sharded_verify_each(mesh: Mesh, g, h, y1, y2, r1, r2, ws, wc):
+    """Per-proof checks over a batch-sharded mesh -> [n] bool.
+
+    ``g``/``h`` unbatched (replicated); row arrays sharded on axis 0.
+    Batch size must be divisible by the mesh size (pad with identity rows
+    and zero windows; padded rows verify True).
+    """
+    rows = P(AXIS)
+    rep = P()
+    fn = shard_map(
+        verify.verify_each_kernel,
+        mesh=mesh,
+        in_specs=(
+            _point_specs(rep),
+            _point_specs(rep),
+            _point_specs(rows),
+            _point_specs(rows),
+            _point_specs(rows),
+            _point_specs(rows),
+            rows,
+            rows,
+        ),
+        out_specs=rows,
+        check_rep=False,
+    )
+    return jax.jit(fn)(g, h, y1, y2, r1, r2, ws, wc)
+
+
+def _combined_partial(r1, y1, r2, y2, w_a, w_ac, w_ba, w_bac):
+    rows = verify._msm_rows(
+        [
+            verify.build_table(r1),
+            verify.build_table(y1),
+            verify.build_table(r2),
+            verify.build_table(y2),
+        ],
+        [w_a, w_ac, w_ba, w_bac],
+    )
+    partial = curve.tree_sum(rows, axis=0)
+    return tuple(c[None] for c in partial)  # [1, 20] per device
+
+
+def sharded_combined_check(mesh: Mesh, r1, y1, r2, y2, w_a, w_ac, w_ba, w_bac):
+    """Combined RLC check over a batch-sharded mesh -> scalar bool.
+
+    Each device reduces its shard to one partial point (local tree-sum);
+    the ``D`` partials are then combined and tested against the identity.
+    The caller has already appended the ``(-sum a s) G + (-b sum a s) H``
+    correction row (see :meth:`cpzk_tpu.ops.backend.TpuBackend.verify_combined`).
+    """
+    rows = P(AXIS)
+    partial_fn = shard_map(
+        _combined_partial,
+        mesh=mesh,
+        in_specs=(
+            _point_specs(rows),
+            _point_specs(rows),
+            _point_specs(rows),
+            _point_specs(rows),
+            rows,
+            rows,
+            rows,
+            rows,
+        ),
+        out_specs=_point_specs(P(AXIS)),
+        check_rep=False,
+    )
+
+    def check(*args):
+        partials = partial_fn(*args)  # [D, 20] coords, one row per device
+        total = curve.tree_sum(partials, axis=0)
+        return curve.is_identity(total)
+
+    return jax.jit(check)(r1, y1, r2, y2, w_a, w_ac, w_ba, w_bac)

@@ -189,28 +189,20 @@ class BatchVerifier:
 
     # --- verification ---
 
-    def _challenge(self, entry: BatchEntry) -> Scalar:
-        """Rebuild the Fiat-Shamir transcript for one entry (batch.rs:239-260)."""
-        transcript = Transcript()
-        if entry.transcript_context is not None:
-            transcript.append_context(entry.transcript_context)
-        transcript.append_parameters(
-            Ristretto255.element_to_bytes(entry.params.generator_g),
-            Ristretto255.element_to_bytes(entry.params.generator_h),
-        )
-        transcript.append_statement(
-            Ristretto255.element_to_bytes(entry.statement.y1),
-            Ristretto255.element_to_bytes(entry.statement.y2),
-        )
-        transcript.append_commitment(
-            Ristretto255.element_to_bytes(entry.proof.commitment.r1),
-            Ristretto255.element_to_bytes(entry.proof.commitment.r2),
-        )
-        return transcript.challenge_scalar()
-
     def _rows(self, rng: SecureRng) -> list[BatchRow]:
+        from ..core.transcript import derive_challenges_batch
+
+        challenges = derive_challenges_batch(
+            [e.transcript_context for e in self.entries],
+            [Ristretto255.element_to_bytes(e.params.generator_g) for e in self.entries],
+            [Ristretto255.element_to_bytes(e.params.generator_h) for e in self.entries],
+            [Ristretto255.element_to_bytes(e.statement.y1) for e in self.entries],
+            [Ristretto255.element_to_bytes(e.statement.y2) for e in self.entries],
+            [Ristretto255.element_to_bytes(e.proof.commitment.r1) for e in self.entries],
+            [Ristretto255.element_to_bytes(e.proof.commitment.r2) for e in self.entries],
+        )
         rows = []
-        for entry in self.entries:
+        for entry, c in zip(self.entries, challenges):
             rows.append(
                 BatchRow(
                     g=entry.params.generator_g,
@@ -220,7 +212,7 @@ class BatchVerifier:
                     r1=entry.proof.commitment.r1,
                     r2=entry.proof.commitment.r2,
                     s=entry.proof.response.s,
-                    c=self._challenge(entry),
+                    c=c,
                     alpha=Ristretto255.random_scalar(rng),
                 )
             )

@@ -1,0 +1,77 @@
+"""Native C++ crypto core vs pure-Python twin: byte-identical transcripts.
+
+If the toolchain is unavailable the native core is skipped gracefully — the
+Python fallback remains the reference behavior."""
+
+import secrets
+
+import pytest
+
+from cpzk_tpu.core import _native
+from cpzk_tpu.core.strobe import Strobe128
+from cpzk_tpu.core.transcript import (
+    CHALLENGE_DST,
+    PROTOCOL_DST,
+    PROTOCOL_LABEL,
+    MerlinTranscript,
+    Transcript,
+    derive_challenges_batch,
+)
+
+native_available = _native.load() is not None
+needs_native = pytest.mark.skipif(not native_available, reason="native core not built")
+
+
+@needs_native
+def test_native_merlin_matches_python():
+    for _ in range(5):
+        label = secrets.token_bytes(secrets.randbelow(40) + 1)
+        py = MerlinTranscript(PROTOCOL_LABEL)
+        nat = _native.NativeMerlin(PROTOCOL_LABEL)
+        msgs = [
+            (b"protocol", PROTOCOL_DST),
+            (b"context", label),
+            (b"big", secrets.token_bytes(700)),  # > strobe rate, forces runs of F
+            (b"empty", b""),
+        ]
+        for lab, msg in msgs:
+            py.append_message(lab, msg)
+            nat.append_message(lab, msg)
+        assert py.challenge_bytes(CHALLENGE_DST, 64) == nat.challenge_bytes(CHALLENGE_DST, 64)
+        # post-challenge state still aligned
+        py.append_message(b"more", b"x")
+        nat.append_message(b"more", b"x")
+        assert py.challenge_bytes(b"c2", 32) == nat.challenge_bytes(b"c2", 32)
+
+
+@needs_native
+def test_native_challenge_batch_matches_python():
+    n = 17
+    # mix of absent (None), empty (b"" -> still appended), and sized contexts
+    contexts = [None if i % 3 == 0 else secrets.token_bytes(i - 1) for i in range(n)]
+    assert b"" in contexts
+    cols = [[secrets.token_bytes(32) for _ in range(n)] for _ in range(6)]
+    native = derive_challenges_batch(contexts, *cols)
+
+    # forced-Python comparison path
+    py = []
+    for i in range(n):
+        t = Transcript.__new__(Transcript)
+        t._t = MerlinTranscript(PROTOCOL_LABEL)
+        t._t.append_message(b"protocol", PROTOCOL_DST)
+        if contexts[i] is not None:
+            t.append_context(contexts[i])
+        t.append_parameters(cols[0][i], cols[1][i])
+        t.append_statement(cols[2][i], cols[3][i])
+        t.append_commitment(cols[4][i], cols[5][i])
+        py.append(t.challenge_scalar())
+    assert [s.value for s in native] == [s.value for s in py]
+
+
+def test_strobe_rate_boundary():
+    """Python Strobe handles absorb/squeeze across the 166-byte rate."""
+    s1 = Strobe128(b"proto")
+    s2 = Strobe128(b"proto")
+    s1.ad(b"a" * 400, False)
+    s2.ad(b"a" * 400, False)
+    assert s1.prf(200, False) == s2.prf(200, False)

@@ -1,0 +1,113 @@
+"""Differential tests: JAX curve kernels vs the integer-exact host edwards
+module. All device functions are jitted once at module scope (eager limb
+arithmetic dispatches thousands of tiny ops)."""
+
+import secrets
+
+import numpy as np
+import pytest
+
+import jax
+
+from cpzk_tpu.core import edwards as he
+from cpzk_tpu.core import scalars as hs
+from cpzk_tpu.ops import curve, limbs
+
+N = 16
+
+j_add = jax.jit(curve.add)
+j_double = jax.jit(curve.double)
+j_eq = jax.jit(curve.eq)
+j_is_identity = jax.jit(curve.is_identity)
+j_scalar_mul = jax.jit(curve.scalar_mul)
+j_tree_sum = jax.jit(curve.tree_sum)
+j_decode = jax.jit(curve.decode)
+j_encode = jax.jit(curve.encode)
+
+
+def rand_points(n: int) -> list[he.Point]:
+    pts = []
+    for _ in range(n - 2):
+        k = secrets.randbelow(hs.L)
+        pts.append(he.pt_scalar_mul(he.BASEPOINT, k))
+    pts.append(he.IDENTITY)
+    pts.append(he.BASEPOINT)
+    return pts
+
+
+@pytest.fixture(scope="module")
+def pts():
+    p = rand_points(N)
+    q = rand_points(N)
+    return p, q, curve.points_to_device(p), curve.points_to_device(q)
+
+
+def assert_points_equal(host_pts: list[he.Point], dev_pt) -> None:
+    got = curve.points_from_device(jax.device_get(dev_pt))
+    for hp, gp in zip(host_pts, got):
+        assert he.pt_eq(hp, tuple(v % he.P for v in gp))
+
+
+def test_add_double(pts):
+    p, q, dp, dq = pts
+    assert_points_equal([he.pt_add(a, b) for a, b in zip(p, q)], j_add(dp, dq))
+    assert_points_equal([he.pt_double(a) for a in p], j_double(dp))
+
+
+def test_eq_identity(pts):
+    p, q, dp, dq = pts
+    assert list(np.asarray(j_eq(dp, dp))) == [True] * N
+    expected = [he.pt_eq(a, b) for a, b in zip(p, q)]
+    assert list(np.asarray(j_eq(dp, dq))) == expected
+    assert list(np.asarray(j_is_identity(dp))) == [he.pt_is_identity(a) for a in p]
+
+
+def test_scalar_mul(pts):
+    p, _, dp, _ = pts
+    ks = [secrets.randbelow(hs.L) for _ in range(N - 2)] + [0, 1]
+    win = curve.scalars_to_windows(ks)
+    expected = [he.pt_scalar_mul(a, k) for a, k in zip(p, ks)]
+    assert_points_equal(expected, j_scalar_mul(dp, win))
+
+
+def test_tree_sum(pts):
+    p, _, dp, _ = pts
+    acc = he.IDENTITY
+    for a in p:
+        acc = he.pt_add(acc, a)
+    assert_points_equal([acc], tuple(c[None] for c in j_tree_sum(dp)))
+
+    # non-power-of-two length
+    p3 = p[:3]
+    dp3 = tuple(c[:3] for c in dp)
+    acc3 = he.pt_add(he.pt_add(p3[0], p3[1]), p3[2])
+    assert_points_equal([acc3], tuple(c[None] for c in j_tree_sum(dp3)))
+
+
+def test_encode_decode_roundtrip(pts):
+    p, _, dp, _ = pts
+    wire_host = [he.ristretto_encode(a) for a in p]
+    enc = np.asarray(j_encode(dp)).astype(np.uint8)
+    assert [bytes(r.tobytes()) for r in enc] == wire_host
+
+    dec, valid = j_decode(jax.numpy.asarray(enc))
+    assert list(np.asarray(valid)) == [True] * N
+    assert_points_equal([he.ristretto_decode(w) for w in wire_host], dec)
+
+
+def test_decode_rejects_invalid():
+    cases = []
+    # non-canonical: p + 1 (encodes as even, >= p)
+    cases.append(((he.P + 1) % 2**256).to_bytes(32, "little"))
+    # negative (odd) s
+    cases.append((3).to_bytes(32, "little"))
+    # s with high bit garbage: all 0xFF
+    cases.append(b"\xff" * 32)
+    # valid encodings for control
+    cases.append(he.ristretto_encode(he.BASEPOINT))
+    # not on curve: s=2 -> check host
+    cases.append((2).to_bytes(32, "little"))
+    arr = np.frombuffer(b"".join(cases), dtype=np.uint8).reshape(len(cases), 32)
+    _, valid = j_decode(jax.numpy.asarray(arr))
+    expected = [he.ristretto_decode(c) is not None for c in cases]
+    assert list(np.asarray(valid)) == expected

@@ -1,0 +1,89 @@
+"""Differential tests: TpuBackend (JAX device plane) vs CpuBackend (host
+oracle) through the full BatchVerifier protocol path — accept/reject must be
+bit-identical (SURVEY.md §4 tier for the TPU build)."""
+
+import pytest
+
+from cpzk_tpu import (
+    BatchVerifier,
+    Parameters,
+    Prover,
+    SecureRng,
+    Statement,
+    Transcript,
+    Witness,
+)
+from cpzk_tpu.core.ristretto import Ristretto255
+from cpzk_tpu.ops.backend import TpuBackend
+from cpzk_tpu.protocol.batch import CpuBackend
+
+
+@pytest.fixture(scope="module")
+def backend():
+    return TpuBackend()
+
+
+def make_entries(n: int, context: bytes | None = None, params: Parameters | None = None):
+    rng = SecureRng()
+    params = params or Parameters.new()
+    entries = []
+    for _ in range(n):
+        prover = Prover(params, Witness(Ristretto255.random_scalar(rng)))
+        transcript = Transcript()
+        if context is not None:
+            transcript.append_context(context)
+        proof = prover.prove_with_transcript(rng, transcript)
+        entries.append((params, prover.statement, proof))
+    return entries
+
+
+def test_combined_accepts_valid_batch(backend):
+    entries = make_entries(5)
+    bv = BatchVerifier(backend=backend)
+    for params, statement, proof in entries:
+        bv.add(params, statement, proof)
+    assert bv.verify(SecureRng()) == [None] * 5
+
+
+def test_mixed_batch_matches_cpu_oracle(backend):
+    entries = make_entries(6)
+    rng = SecureRng()
+    # corrupt entry 2: swap in a statement from a different witness
+    params = entries[2][0]
+    wrong = Statement.from_witness(params, Witness(Ristretto255.random_scalar(rng)))
+    entries[2] = (params, wrong, entries[2][2])
+
+    results = {}
+    for name, be in (("tpu", backend), ("cpu", CpuBackend())):
+        bv = BatchVerifier(backend=be)
+        for p, st, pr in entries:
+            bv.add(p, st, pr)
+        results[name] = [e is None for e in bv.verify(SecureRng())]
+
+    assert results["tpu"] == results["cpu"] == [True, True, False, True, True, True]
+
+
+def test_context_bound_batch(backend):
+    entries = make_entries(4, context=b"batch-ctx")
+    bv = BatchVerifier(backend=backend)
+    for p, st, pr in entries:
+        bv.add_with_context(p, st, pr, b"batch-ctx")
+    assert bv.verify(SecureRng()) == [None] * 4
+
+    # wrong context -> every proof rejected, same as CPU oracle
+    bv2 = BatchVerifier(backend=backend)
+    for p, st, pr in entries:
+        bv2.add_with_context(p, st, pr, b"other-ctx")
+    assert all(e is not None for e in bv2.verify(SecureRng()))
+
+
+def test_custom_generators_batch(backend):
+    rng = SecureRng()
+    g = Ristretto255.scalar_mul(Ristretto255.generator_g(), Ristretto255.random_scalar(rng))
+    h = Ristretto255.scalar_mul(Ristretto255.generator_h(), Ristretto255.random_scalar(rng))
+    params = Parameters.with_generators(g, h)
+    entries = make_entries(3, params=params)
+    bv = BatchVerifier(backend=backend)
+    for p, st, pr in entries:
+        bv.add(p, st, pr)
+    assert bv.verify(SecureRng()) == [None] * 3
