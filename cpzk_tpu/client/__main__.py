@@ -1,0 +1,227 @@
+"""Interactive auth client REPL (reference ``src/bin/client.rs`` twin).
+
+Commands (+ short aliases, client.rs:47-123): /register /r, /login /l,
+/batch-register /br, /batch-login /bl, /status /st, /help /h /?,
+/quit /exit /q.  Passwords never leave the client; registration sends the
+statement (y1, y2) derived via the Argon2id KDF and login proves knowledge
+of the derived scalar against a single-use server challenge.
+
+Run: ``python -m cpzk_tpu.client --server 127.0.0.1:50051``
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import os
+import sys
+
+import grpc
+
+from .. import Parameters, Prover, SecureRng, Transcript, Witness
+from ..core.ristretto import Ristretto255
+from .kdf import password_to_scalar
+from .rpc import AuthClient
+
+
+def _c(color: str, text: str) -> str:
+    codes = {"green": "32", "red": "31", "yellow": "33", "cyan": "36", "white": "37"}
+    if not sys.stdout.isatty():
+        return text
+    return f"\x1b[{codes[color]}m{text}\x1b[0m"
+
+
+def parse_args(argv=None) -> argparse.Namespace:
+    p = argparse.ArgumentParser(prog="cpzk-client", description="Chaum-Pedersen auth client")
+    p.add_argument(
+        "-s", "--server", default=os.environ.get("AUTH_SERVER", "127.0.0.1:50051")
+    )
+    return p.parse_args(argv)
+
+
+async def do_register(client: AuthClient, user: str, password: str) -> str:
+    """client.rs:206-233."""
+    x = password_to_scalar(password, user)
+    prover = Prover(Parameters.new(), Witness(x))
+    st = prover.statement
+    try:
+        resp = await client.register(
+            user,
+            Ristretto255.element_to_bytes(st.y1),
+            Ristretto255.element_to_bytes(st.y2),
+        )
+    except grpc.aio.AioRpcError as e:
+        return _c("red", f"Failed: {e.details()}")
+    color = "green" if resp.success else "red"
+    word = "Registered" if resp.success else "Failed"
+    return _c(color, f"{word}: {resp.message}")
+
+
+async def do_login(client: AuthClient, user: str, password: str) -> str:
+    """client.rs:235-285: challenge -> prove with challenge-id context -> verify."""
+    try:
+        ch = await client.create_challenge(user)
+        cid = bytes(ch.challenge_id)
+        x = password_to_scalar(password, user)
+        prover = Prover(Parameters.new(), Witness(x))
+        transcript = Transcript()
+        transcript.append_context(cid)
+        proof = prover.prove_with_transcript(SecureRng(), transcript)
+        resp = await client.verify_proof(user, cid, proof.to_bytes())
+    except grpc.aio.AioRpcError as e:
+        return _c("red", f"Login failed: {e.details()}")
+    if resp.success:
+        return _c("green", f"Login OK: {resp.message}\n  session: {resp.session_token}")
+    return _c("red", f"Login failed: {resp.message}")
+
+
+async def do_batch_register(client: AuthClient, users: list[str], passwords: list[str]) -> str:
+    """client.rs:287-340."""
+    y1s, y2s = [], []
+    for user, password in zip(users, passwords):
+        prover = Prover(Parameters.new(), Witness(password_to_scalar(password, user)))
+        y1s.append(Ristretto255.element_to_bytes(prover.statement.y1))
+        y2s.append(Ristretto255.element_to_bytes(prover.statement.y2))
+    try:
+        resp = await client.register_batch(users, y1s, y2s)
+    except grpc.aio.AioRpcError as e:
+        return _c("red", f"Batch register failed: {e.details()}")
+    lines = []
+    for user, r in zip(users, resp.results):
+        color = "green" if r.success else "red"
+        lines.append(_c(color, f"  {user}: {r.message}"))
+    ok = sum(1 for r in resp.results if r.success)
+    lines.append(_c("cyan", f"{ok}/{len(users)} registered"))
+    return "\n".join(lines)
+
+
+async def do_batch_login(client: AuthClient, users: list[str], passwords: list[str]) -> str:
+    """client.rs:342-411: per-user challenges, one batch verification RPC."""
+    rng = SecureRng()
+    ids, cids, proofs = [], [], []
+    errors = {}
+    for user, password in zip(users, passwords):
+        try:
+            ch = await client.create_challenge(user)
+        except grpc.aio.AioRpcError as e:
+            errors[user] = e.details()
+            continue
+        cid = bytes(ch.challenge_id)
+        prover = Prover(Parameters.new(), Witness(password_to_scalar(password, user)))
+        transcript = Transcript()
+        transcript.append_context(cid)
+        proofs.append(prover.prove_with_transcript(rng, transcript).to_bytes())
+        ids.append(user)
+        cids.append(cid)
+    lines = [_c("red", f"  {u}: challenge failed: {msg}") for u, msg in errors.items()]
+    if ids:
+        try:
+            resp = await client.verify_proof_batch(ids, cids, proofs)
+        except grpc.aio.AioRpcError as e:
+            return _c("red", f"Batch login failed: {e.details()}")
+        for user, r in zip(ids, resp.results):
+            if r.success:
+                lines.append(_c("green", f"  {user}: OK session={r.session_token[:16]}..."))
+            else:
+                lines.append(_c("red", f"  {user}: {r.message}"))
+    return "\n".join(lines) if lines else _c("yellow", "nothing to do")
+
+
+async def do_status(client: AuthClient, server_addr: str) -> str:
+    """client.rs:497-528: probe the server with a timeout'd RPC."""
+    try:
+        resp = await client.health_check(timeout=2.0)
+        if resp.status == 1:
+            return _c("green", f"Server {server_addr}: SERVING")
+        return _c("yellow", f"Server {server_addr}: NOT SERVING (status={resp.status})")
+    except Exception:
+        pass
+    try:
+        await client.create_challenge("__status_probe__", timeout=2.0)
+        return _c("green", f"Server {server_addr}: reachable")
+    except grpc.aio.AioRpcError as e:
+        if e.code() in (grpc.StatusCode.NOT_FOUND, grpc.StatusCode.INVALID_ARGUMENT,
+                        grpc.StatusCode.RESOURCE_EXHAUSTED):
+            return _c("green", f"Server {server_addr}: reachable")
+        return _c("red", f"Server {server_addr}: unreachable ({e.code().name})")
+
+
+HELP = """Available commands:
+  /register <user> <password>            (/r)   register a new user
+  /login <user> <password>               (/l)   authenticate
+  /batch-register <u1,u2> <p1,p2>        (/br)  register several users
+  /batch-login <u1,u2> <p1,p2>           (/bl)  authenticate several users
+  /status                                (/st)  probe the server
+  /help                                  (/h)   this help
+  /quit                                  (/q)   exit"""
+
+
+async def handle_line(line: str, client: AuthClient, server_addr: str) -> tuple[str, bool]:
+    line = line.strip()
+    if not line:
+        return "", False
+    if not line.startswith("/"):
+        return "Commands must start with '/'. Type /help for available commands.", False
+    parts = line.split(" ", 3)
+    cmd = parts[0].lower()
+
+    def two_args(usage: str):
+        if len(parts) < 3:
+            return None
+        return parts[1], parts[2]
+
+    if cmd in ("/register", "/r"):
+        args = two_args("/register")
+        if args is None:
+            return "Usage: /register <user_id> <password>", False
+        return await do_register(client, *args), False
+    if cmd in ("/login", "/l"):
+        args = two_args("/login")
+        if args is None:
+            return "Usage: /login <user_id> <password>", False
+        return await do_login(client, *args), False
+    if cmd in ("/batch-register", "/br", "/batch-login", "/bl"):
+        args = two_args(cmd)
+        if args is None:
+            return f"Usage: {cmd} <user1,user2,...> <pass1,pass2,...>", False
+        users = [u.strip() for u in args[0].split(",")]
+        passwords = [p.strip() for p in args[1].split(",")]
+        if len(users) != len(passwords):
+            return (
+                f"Number of users ({len(users)}) must match number of passwords ({len(passwords)})",
+                False,
+            )
+        if cmd in ("/batch-register", "/br"):
+            return await do_batch_register(client, users, passwords), False
+        return await do_batch_login(client, users, passwords), False
+    if cmd in ("/status", "/st"):
+        return await do_status(client, server_addr), False
+    if cmd in ("/help", "/h", "/?"):
+        return HELP, False
+    if cmd in ("/quit", "/exit", "/q"):
+        return "bye", True
+    return f"Unknown command: {cmd}. Type /help for available commands.", False
+
+
+async def amain(args) -> None:
+    async with AuthClient(args.server) as client:
+        print(_c("cyan", f"Connected to {args.server}. Type /help for commands."))
+        while True:
+            try:
+                line = await asyncio.to_thread(input, "> ")
+            except (EOFError, KeyboardInterrupt):
+                print()
+                return
+            out, quit_ = await handle_line(line, client, args.server)
+            if out:
+                print(out)
+            if quit_:
+                return
+
+
+def main() -> None:
+    asyncio.run(amain(parse_args()))
+
+
+if __name__ == "__main__":
+    main()

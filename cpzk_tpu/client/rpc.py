@@ -1,0 +1,96 @@
+"""Async gRPC client for the auth service (hand-wired stubs).
+
+Mirrors the RPC surface the reference client drives through its generated
+``AuthServiceClient`` (``src/bin/client.rs``); method paths and message
+types come straight from ``proto/auth.proto``.
+"""
+
+from __future__ import annotations
+
+import grpc
+
+from ..server.proto import SERVICE_NAME, load_pb2, method_types
+
+
+class AuthClient:
+    """Thin unary-unary stub set over a grpc.aio channel."""
+
+    def __init__(self, target: str, credentials: grpc.ChannelCredentials | None = None):
+        self.pb2 = load_pb2()
+        if credentials is not None:
+            self.channel = grpc.aio.secure_channel(target, credentials)
+        else:
+            self.channel = grpc.aio.insecure_channel(target)
+        types = method_types(self.pb2)
+        self._stubs = {
+            name: self.channel.unary_unary(
+                f"/{SERVICE_NAME}/{name}",
+                request_serializer=req.SerializeToString,
+                response_deserializer=resp.FromString,
+            )
+            for name, (req, resp) in types.items()
+        }
+
+    async def close(self) -> None:
+        await self.channel.close()
+
+    async def __aenter__(self) -> "AuthClient":
+        return self
+
+    async def __aexit__(self, *exc) -> None:
+        await self.close()
+
+    # --- RPCs ---
+
+    async def register(self, user_id: str, y1: bytes, y2: bytes, timeout: float | None = None):
+        return await self._stubs["Register"](
+            self.pb2.RegistrationRequest(user_id=user_id, y1=y1, y2=y2), timeout=timeout
+        )
+
+    async def register_batch(
+        self, user_ids: list[str], y1_values: list[bytes], y2_values: list[bytes],
+        timeout: float | None = None,
+    ):
+        return await self._stubs["RegisterBatch"](
+            self.pb2.BatchRegistrationRequest(
+                user_ids=user_ids, y1_values=y1_values, y2_values=y2_values
+            ),
+            timeout=timeout,
+        )
+
+    async def create_challenge(self, user_id: str, timeout: float | None = None):
+        return await self._stubs["CreateChallenge"](
+            self.pb2.ChallengeRequest(user_id=user_id), timeout=timeout
+        )
+
+    async def verify_proof(
+        self, user_id: str, challenge_id: bytes, proof: bytes, timeout: float | None = None
+    ):
+        return await self._stubs["VerifyProof"](
+            self.pb2.VerificationRequest(
+                user_id=user_id, challenge_id=challenge_id, proof=proof
+            ),
+            timeout=timeout,
+        )
+
+    async def verify_proof_batch(
+        self, user_ids: list[str], challenge_ids: list[bytes], proofs: list[bytes],
+        timeout: float | None = None,
+    ):
+        return await self._stubs["VerifyProofBatch"](
+            self.pb2.BatchVerificationRequest(
+                user_ids=user_ids, challenge_ids=challenge_ids, proofs=proofs
+            ),
+            timeout=timeout,
+        )
+
+    async def health_check(self, timeout: float | None = None):
+        from ..server.proto import load_health_pb2
+
+        pb2 = load_health_pb2()
+        stub = self.channel.unary_unary(
+            "/grpc.health.v1.Health/Check",
+            request_serializer=pb2.HealthCheckRequest.SerializeToString,
+            response_deserializer=pb2.HealthCheckResponse.FromString,
+        )
+        return await stub(pb2.HealthCheckRequest(service=""), timeout=timeout)

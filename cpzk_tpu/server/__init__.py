@@ -1,0 +1,20 @@
+"""Serving plane: the gRPC password-less authentication system.
+
+Re-design of the reference's server stack (SURVEY.md §2.1 #10-#14) on
+asyncio grpcio: same ``auth.proto`` wire contract, same validation limits
+and state-machine semantics (single-use challenges, TTLs, per-user caps),
+with the lock-order hazard of the reference's five-lock state store fixed
+by a single asyncio lock (SURVEY.md §5 race-detection note).
+"""
+
+from .config import RateLimiter, ServerConfig
+from .state import ChallengeData, ServerState, SessionData, UserData
+
+__all__ = [
+    "ChallengeData",
+    "RateLimiter",
+    "ServerConfig",
+    "ServerState",
+    "SessionData",
+    "UserData",
+]

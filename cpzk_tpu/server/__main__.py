@@ -1,0 +1,212 @@
+"""Server daemon + admin REPL (reference ``src/bin/server.rs`` twin).
+
+Flags (env-overridable like the clap definitions at server.rs:20-48), config
+load + validation, background cleanup task under a panic-restarting
+supervisor, optional Prometheus exporter, gRPC health, a colored admin REPL
+(/status /users /sessions /challenges /cleanup /help /quit), and graceful
+shutdown: health flips to NOT_SERVING, 2 s drain, then the listener stops
+(server.rs:379-427).
+
+Run: ``python -m cpzk_tpu.server --host 127.0.0.1 --port 50051``
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import logging
+import os
+import signal
+import sys
+
+from .config import RateLimiter, ServerConfig
+from .state import ServerState
+
+CLEANUP_INTERVAL_SECONDS = 60
+SUPERVISOR_BACKOFF_SECONDS = 5
+DRAIN_SECONDS = 2
+
+log = logging.getLogger("cpzk_tpu.server")
+
+
+def _c(color: str, text: str) -> str:
+    codes = {"green": "32", "red": "31", "yellow": "33", "cyan": "36", "white": "37"}
+    if not sys.stdout.isatty():
+        return text
+    return f"\x1b[{codes[color]}m{text}\x1b[0m"
+
+
+def parse_args(argv=None) -> argparse.Namespace:
+    p = argparse.ArgumentParser(prog="cpzk-server", description="Chaum-Pedersen auth server")
+    p.add_argument("-H", "--host", default=os.environ.get("SERVER_HOST", "127.0.0.1"))
+    p.add_argument("-p", "--port", type=int, default=int(os.environ.get("SERVER_PORT", "50051")))
+    p.add_argument("--metrics", action="store_true",
+                   default=os.environ.get("SERVER_METRICS", "").lower() in ("1", "true"))
+    p.add_argument("--metrics-port", type=int,
+                   default=int(os.environ.get("SERVER_METRICS_PORT", "9090")))
+    p.add_argument("--rate-limit", type=int,
+                   default=int(os.environ.get("SERVER_RATE_LIMIT", "100")))
+    p.add_argument("--rate-burst", type=int,
+                   default=int(os.environ.get("SERVER_RATE_BURST", "10")))
+    p.add_argument("--no-repl", action="store_true", help="run headless (no admin REPL)")
+    return p.parse_args(argv)
+
+
+async def cleanup_supervisor(state: ServerState, stop: asyncio.Event) -> None:
+    """Periodic expiry sweeps under a restart-on-crash supervisor
+    (server.rs:168-192)."""
+
+    async def sweep_loop():
+        while not stop.is_set():
+            try:
+                await asyncio.wait_for(stop.wait(), timeout=CLEANUP_INTERVAL_SECONDS)
+                return
+            except asyncio.TimeoutError:
+                pass
+            nc = await state.cleanup_expired_challenges()
+            ns = await state.cleanup_expired_sessions()
+            if nc or ns:
+                log.info("cleanup: %d challenges, %d sessions expired", nc, ns)
+
+    while not stop.is_set():
+        try:
+            await sweep_loop()
+            return
+        except asyncio.CancelledError:
+            raise
+        except Exception:
+            log.exception("cleanup task crashed; restarting in %ss", SUPERVISOR_BACKOFF_SECONDS)
+            try:
+                await asyncio.wait_for(stop.wait(), timeout=SUPERVISOR_BACKOFF_SECONDS)
+            except asyncio.TimeoutError:
+                pass
+
+
+HELP = """Available commands:
+  /status      (/st)  server status summary
+  /users       (/u)   registered user count
+  /sessions    (/s)   active session count
+  /challenges  (/c)   pending challenge count
+  /cleanup     (/gc)  run an expiry sweep now
+  /help        (/h)   this help
+  /quit        (/q)   graceful shutdown"""
+
+
+async def handle_command(cmd: str, state: ServerState) -> tuple[str, bool]:
+    """(output, should_quit) for one REPL line (server.rs:50-90,261-359)."""
+    cmd = cmd.strip()
+    if not cmd:
+        return "", False
+    if not cmd.startswith("/"):
+        return "Commands must start with '/'. Type /help for available commands.", False
+    word = cmd.split()[0].lower()
+    if word in ("/status", "/st"):
+        u, s, c = (
+            await state.user_count(),
+            await state.session_count(),
+            await state.challenge_count(),
+        )
+        return f"users={u} sessions={s} challenges={c}", False
+    if word in ("/users", "/u"):
+        return f"registered users: {await state.user_count()}", False
+    if word in ("/sessions", "/s"):
+        return f"active sessions: {await state.session_count()}", False
+    if word in ("/challenges", "/c"):
+        return f"pending challenges: {await state.challenge_count()}", False
+    if word in ("/cleanup", "/gc"):
+        nc = await state.cleanup_expired_challenges()
+        ns = await state.cleanup_expired_sessions()
+        return f"cleanup done: {nc} challenges, {ns} sessions removed", False
+    if word in ("/help", "/h", "/?"):
+        return HELP, False
+    if word in ("/quit", "/exit", "/q"):
+        return "shutting down...", True
+    return f"Unknown command: {word}. Type /help for available commands.", False
+
+
+async def amain(args) -> None:
+    logging.basicConfig(
+        level=os.environ.get("RUST_LOG", os.environ.get("LOG_LEVEL", "INFO")).upper(),
+        format="%(asctime)s %(levelname)s %(name)s: %(message)s",
+    )
+
+    config = ServerConfig.from_env()
+    # CLI flags override (the reference leaves these unreconciled; here the
+    # resolved config is the single source — SURVEY.md §3.3)
+    config.host = args.host
+    config.port = args.port
+    config.rate_limit.requests_per_minute = args.rate_limit
+    config.rate_limit.burst = args.rate_burst
+    config.metrics.enabled = args.metrics
+    config.metrics.port = args.metrics_port
+    config.validate()
+
+    state = ServerState()
+    limiter = config.rate_limit.build_limiter()
+    stop = asyncio.Event()
+
+    cleanup_task = asyncio.create_task(cleanup_supervisor(state, stop))
+
+    if config.metrics.enabled:
+        from . import metrics
+
+        if metrics.start_exporter(config.metrics.host, config.metrics.port):
+            log.info("metrics exporter on %s:%d", config.metrics.host, config.metrics.port)
+
+    tls = None
+    if config.tls.enabled:
+        with open(config.tls.key_path, "rb") as f:
+            key = f.read()
+        with open(config.tls.cert_path, "rb") as f:
+            cert = f.read()
+        tls = (key, cert)
+
+    from .service import serve
+
+    server, port = await serve(
+        state, limiter, host=config.host, port=config.port, tls=tls
+    )
+    print(_c("green", f"AuthService listening on {config.host}:{port}"))
+
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        loop.add_signal_handler(sig, stop.set)
+
+    async def repl():
+        print(_c("cyan", "Admin REPL ready. Type /help for commands."))
+        while not stop.is_set():
+            try:
+                line = await asyncio.to_thread(input, "> ")
+            except (EOFError, KeyboardInterrupt):
+                stop.set()
+                return
+            out, quit_ = await handle_command(line, state)
+            if out:
+                print(_c("white", out))
+            if quit_:
+                stop.set()
+                return
+
+    repl_task = None
+    if not args.no_repl and sys.stdin.isatty():
+        repl_task = asyncio.create_task(repl())
+
+    await stop.wait()
+
+    # graceful shutdown: not-serving -> drain -> stop (server.rs:379-427)
+    print(_c("yellow", "shutdown: flipping health to NOT_SERVING, draining..."))
+    server.health.serving = False
+    await asyncio.sleep(DRAIN_SECONDS)
+    await server.stop(grace=5)
+    cleanup_task.cancel()
+    if repl_task is not None:
+        repl_task.cancel()
+    print(_c("green", "bye"))
+
+
+def main() -> None:
+    asyncio.run(amain(parse_args()))
+
+
+if __name__ == "__main__":
+    main()

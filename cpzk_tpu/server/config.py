@@ -1,0 +1,178 @@
+"""Server configuration and rate limiting.
+
+Same layering and names as the reference (``src/verifier/config.rs``):
+defaults <- TOML file (path from ``SERVER_CONFIG_PATH``, default
+``config/server.toml``) <- ``.env`` file <- ``SERVER_*`` environment
+variables, then CLI flags on top (the reference leaves CLI/figment
+unreconciled — SURVEY.md §3.3 flags it; here the CLI layer goes through the
+same resolved object). Token-bucket rate limiter with fractional refill and
+burst cap (``config.rs:103-118``).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+import asyncio
+import tomllib
+from dataclasses import dataclass, field
+
+
+@dataclass
+class RateLimitSettings:
+    requests_per_minute: int = 100
+    burst: int = 10
+
+    def build_limiter(self) -> "RateLimiter":
+        return RateLimiter(self.requests_per_minute, self.burst)
+
+
+@dataclass
+class MetricsSettings:
+    enabled: bool = True
+    host: str = "127.0.0.1"
+    port: int = 9090
+
+
+@dataclass
+class TlsSettings:
+    enabled: bool = False
+    cert_path: str = ""
+    key_path: str = ""
+
+
+@dataclass
+class ServerConfig:
+    host: str = "127.0.0.1"
+    port: int = 50051
+    rate_limit: RateLimitSettings = field(default_factory=RateLimitSettings)
+    metrics: MetricsSettings = field(default_factory=MetricsSettings)
+    tls: TlsSettings = field(default_factory=TlsSettings)
+
+    def addr(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    # --- loading (config.rs:218-232 precedence) ---
+
+    @classmethod
+    def from_env(cls) -> "ServerConfig":
+        _load_dotenv()
+        cfg = cls()
+        config_path = os.environ.get("SERVER_CONFIG_PATH", "config/server.toml")
+        if os.path.exists(config_path):
+            with open(config_path, "rb") as f:
+                cfg._merge_mapping(tomllib.load(f))
+        cfg._merge_env()
+        return cfg
+
+    def _merge_mapping(self, data: dict) -> None:
+        if "host" in data:
+            self.host = str(data["host"])
+        if "port" in data:
+            self.port = int(data["port"])
+        for section, obj in (
+            ("rate_limit", self.rate_limit),
+            ("metrics", self.metrics),
+            ("tls", self.tls),
+        ):
+            for key, value in data.get(section, {}).items():
+                if hasattr(obj, key):
+                    setattr(obj, key, type(getattr(obj, key))(value))
+
+    def _merge_env(self) -> None:
+        """``SERVER_`` prefix, components split on ``_`` like figment's
+        ``Env.prefixed("SERVER_").split("_")`` (nested keys greedy-match the
+        known sections, e.g. SERVER_RATE_LIMIT_BURST)."""
+        env = os.environ
+
+        def get(name: str) -> str | None:
+            return env.get(f"SERVER_{name}")
+
+        if (v := get("HOST")) is not None:
+            self.host = v
+        if (v := get("PORT")) is not None:
+            self.port = int(v)
+        if (v := get("RATE_LIMIT_REQUESTS_PER_MINUTE")) is not None:
+            self.rate_limit.requests_per_minute = int(v)
+        if (v := get("RATE_LIMIT_BURST")) is not None:
+            self.rate_limit.burst = int(v)
+        if (v := get("METRICS_ENABLED")) is not None:
+            self.metrics.enabled = v.lower() in ("1", "true", "yes", "on")
+        if (v := get("METRICS_HOST")) is not None:
+            self.metrics.host = v
+        if (v := get("METRICS_PORT")) is not None:
+            self.metrics.port = int(v)
+        if (v := get("TLS_ENABLED")) is not None:
+            self.tls.enabled = v.lower() in ("1", "true", "yes", "on")
+        if (v := get("TLS_CERT_PATH")) is not None:
+            self.tls.cert_path = v
+        if (v := get("TLS_KEY_PATH")) is not None:
+            self.tls.key_path = v
+
+    # --- validation (config.rs:238-273) ---
+
+    def validate(self) -> None:
+        if self.tls.enabled:
+            if not self.tls.cert_path:
+                raise ValueError("TLS is enabled but cert_path is empty")
+            if not self.tls.key_path:
+                raise ValueError("TLS is enabled but key_path is empty")
+            if not os.path.exists(self.tls.cert_path):
+                raise ValueError(
+                    f"TLS certificate file does not exist: {self.tls.cert_path}"
+                )
+            if not os.path.exists(self.tls.key_path):
+                raise ValueError(f"TLS key file does not exist: {self.tls.key_path}")
+        if self.rate_limit.requests_per_minute == 0:
+            raise ValueError("Rate limit requests_per_minute cannot be zero")
+        if self.rate_limit.burst == 0:
+            raise ValueError("Rate limit burst cannot be zero")
+
+
+def _load_dotenv() -> None:
+    """Minimal ``.env`` loader (dotenvy twin): walks up from cwd, first file
+    wins, existing environment variables are never overridden."""
+    d = os.getcwd()
+    while True:
+        path = os.path.join(d, ".env")
+        if os.path.exists(path):
+            with open(path, encoding="utf-8") as f:
+                for line in f:
+                    line = line.strip()
+                    if not line or line.startswith("#") or "=" not in line:
+                        continue
+                    key, _, value = line.partition("=")
+                    key = key.strip()
+                    value = value.strip().strip("\"'")
+                    os.environ.setdefault(key, value)
+            return
+        parent = os.path.dirname(d)
+        if parent == d:
+            return
+        d = parent
+
+
+class RateLimitExceeded(Exception):
+    pass
+
+
+class RateLimiter:
+    """Token bucket with fractional refill (config.rs:64-118 twin)."""
+
+    def __init__(self, requests_per_minute: int, burst: int):
+        self.rate = requests_per_minute
+        self.burst = burst
+        self._tokens = float(burst)
+        self._last_update = time.monotonic()
+        self._lock = asyncio.Lock()
+
+    async def check_rate_limit(self) -> None:
+        async with self._lock:
+            now = time.monotonic()
+            elapsed = now - self._last_update
+            self._tokens = min(self._tokens + elapsed * (self.rate / 60.0), float(self.burst))
+            if self._tokens >= 1.0:
+                self._tokens -= 1.0
+                self._last_update = now
+            else:
+                raise RateLimitExceeded("Rate limit exceeded")

@@ -1,0 +1,67 @@
+"""RPC metrics: counters + duration histograms with Prometheus export.
+
+The reference instruments every RPC through the ``metrics`` facade with a
+``metrics-exporter-prometheus`` scrape endpoint (``service.rs`` passim,
+``bin/server.rs:194-206``). Same metric names here (dots become underscores
+in the Prometheus exposition, matching the exporter's convention), backed by
+``prometheus_client`` when importable and by inert no-ops otherwise so the
+service code never branches.
+"""
+
+from __future__ import annotations
+
+try:
+    from prometheus_client import Counter as _PCounter
+    from prometheus_client import Histogram as _PHistogram
+    from prometheus_client import start_http_server as _start_http_server
+
+    HAVE_PROMETHEUS = True
+except ImportError:  # pragma: no cover
+    HAVE_PROMETHEUS = False
+
+_REGISTRY: dict[str, object] = {}
+
+
+def _sanitize(name: str) -> str:
+    return name.replace(".", "_")
+
+
+class _NoopMetric:
+    def inc(self, *_a) -> None:
+        pass
+
+    def observe(self, *_a) -> None:
+        pass
+
+
+def counter(name: str):
+    """counter!("auth.register.requests") twin."""
+    key = "c:" + name
+    if key not in _REGISTRY:
+        if HAVE_PROMETHEUS:
+            _REGISTRY[key] = _PCounter(_sanitize(name), f"counter {name}")
+        else:
+            _REGISTRY[key] = _NoopMetric()
+    return _REGISTRY[key]
+
+
+def histogram(name: str):
+    """histogram!("auth.register.duration") twin."""
+    key = "h:" + name
+    if key not in _REGISTRY:
+        if HAVE_PROMETHEUS:
+            _REGISTRY[key] = _PHistogram(_sanitize(name), f"histogram {name}")
+        else:
+            _REGISTRY[key] = _NoopMetric()
+    return _REGISTRY[key]
+
+
+def start_exporter(host: str, port: int) -> bool:
+    """Serve the Prometheus scrape endpoint (bin/server.rs:194-206 twin).
+
+    Returns False when prometheus_client is unavailable.
+    """
+    if not HAVE_PROMETHEUS:
+        return False
+    _start_http_server(port, addr=host)
+    return True

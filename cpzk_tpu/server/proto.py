@@ -1,0 +1,73 @@
+"""Protobuf message bindings for ``proto/auth.proto``.
+
+``grpc_tools`` is not available in this environment, so the message module
+is generated with the ``protoc`` binary on first import (into
+``cpzk_tpu/_gen/``) and the gRPC plumbing is hand-wired from grpcio's
+generic handler API instead of a generated ``*_pb2_grpc`` module (see
+``service.py`` / ``client/rpc.py``). Reference analog: ``build.rs:1-12``
+compiling the proto with tonic-build at build time.
+"""
+
+from __future__ import annotations
+
+import importlib
+import os
+import subprocess
+import sys
+
+_PKG_DIR = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_GEN_DIR = os.path.join(_PKG_DIR, "_gen")
+_PROTO_DIR = os.path.join(os.path.dirname(_PKG_DIR), "proto")
+
+SERVICE_NAME = "auth.AuthService"
+
+_METHODS = {
+    "Register": ("RegistrationRequest", "RegistrationResponse"),
+    "RegisterBatch": ("BatchRegistrationRequest", "BatchRegistrationResponse"),
+    "CreateChallenge": ("ChallengeRequest", "ChallengeResponse"),
+    "VerifyProof": ("VerificationRequest", "VerificationResponse"),
+    "VerifyProofBatch": ("BatchVerificationRequest", "BatchVerificationResponse"),
+}
+
+
+def _generate(name: str) -> None:
+    os.makedirs(_GEN_DIR, exist_ok=True)
+    open(os.path.join(_GEN_DIR, "__init__.py"), "a").close()
+    subprocess.run(
+        [
+            "protoc",
+            f"--python_out={_GEN_DIR}",
+            f"-I{_PROTO_DIR}",
+            name,
+        ],
+        check=True,
+        capture_output=True,
+        timeout=60,
+    )
+
+
+def _load(module: str, proto_name: str):
+    gen_path = os.path.join(_GEN_DIR, module + ".py")
+    if not os.path.exists(gen_path):
+        _generate(proto_name)
+    if _GEN_DIR not in sys.path:
+        sys.path.insert(0, _GEN_DIR)
+    return importlib.import_module(module)
+
+
+def load_pb2():
+    """The generated ``auth_pb2`` module (generating it if needed)."""
+    return _load("auth_pb2", "auth.proto")
+
+
+def load_health_pb2():
+    """The generated ``health_pb2`` module (grpc.health.v1)."""
+    return _load("health_pb2", "health.proto")
+
+
+def method_types(pb2):
+    """{rpc name: (request class, response class)} for all five RPCs."""
+    return {
+        name: (getattr(pb2, req), getattr(pb2, resp))
+        for name, (req, resp) in _METHODS.items()
+    }

@@ -1,0 +1,505 @@
+"""gRPC AuthService implementation (asyncio).
+
+Behavior parity with the reference service (``src/verifier/service.rs``):
+identical validation limits and error strings, opaque "Authentication
+failed" for anything secret-adjacent, challenge consumption BEFORE
+verification (replay cannot retry a failed proof), per-item results for the
+batch RPCs, 32-byte challenge ids and hex session tokens, and the same
+metric names. The gRPC plumbing is hand-wired through grpcio's generic
+handler API because the protoc gRPC plugin is unavailable (see proto.py).
+"""
+
+from __future__ import annotations
+
+import time
+
+import grpc
+
+from .. import errors
+from ..core.ristretto import Ristretto255
+from ..core.rng import SecureRng
+from ..core.transcript import Transcript
+from ..protocol.batch import BatchVerifier, VerifierBackend
+from ..protocol.gadgets import Parameters, Proof, Statement
+from ..protocol.verifier import Verifier
+from . import metrics
+from .config import RateLimiter, RateLimitExceeded
+from .proto import SERVICE_NAME, load_pb2, method_types
+from .state import ServerState, UserData
+
+MAX_USER_ID_LEN = 256
+MAX_ELEMENT_WIRE = 4096
+MAX_CHALLENGE_ID = 64
+MAX_PROOF_WIRE = 8192
+MAX_BATCH = 1000
+
+
+def _valid_user_id_chars(user_id: str) -> bool:
+    return all(c.isalnum() or c in "_-." for c in user_id)
+
+
+class AuthServiceImpl:
+    """The five RPCs (service.rs:59-617 twin)."""
+
+    def __init__(
+        self,
+        state: ServerState,
+        rate_limiter: RateLimiter,
+        backend: VerifierBackend | None = None,
+    ):
+        self.state = state
+        self.rate_limiter = rate_limiter
+        self.backend = backend
+        self.pb2 = load_pb2()
+        self.rng = SecureRng()
+
+    # --- helpers ---
+
+    async def _check_rate(self, context) -> None:
+        try:
+            await self.rate_limiter.check_rate_limit()
+        except RateLimitExceeded:
+            await context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED, "Rate limit exceeded")
+
+    @staticmethod
+    async def _validate_user_id(user_id: str, context) -> None:
+        msg = _user_id_error(user_id)
+        if msg is not None:
+            await context.abort(grpc.StatusCode.INVALID_ARGUMENT, msg)
+
+    def _parse_statement(self, y1_bytes: bytes, y2_bytes: bytes) -> Statement:
+        """Shared register-path statement validation; raises errors.Error
+        with the reference's message prefixes."""
+        try:
+            y1 = Ristretto255.element_from_bytes(y1_bytes)
+        except errors.Error as e:
+            raise errors.InvalidParams(f"Invalid y1: {e}") from None
+        try:
+            y2 = Ristretto255.element_from_bytes(y2_bytes)
+        except errors.Error as e:
+            raise errors.InvalidParams(f"Invalid y2: {e}") from None
+        statement = Statement(y1, y2)
+        try:
+            statement.validate()
+        except errors.Error as e:
+            raise errors.InvalidParams(f"Invalid statement: {e}") from None
+        if Ristretto255.is_identity(y1) or Ristretto255.is_identity(y2):
+            raise errors.InvalidParams("Statement contains identity elements")
+        return statement
+
+    # --- RPCs ---
+
+    async def register(self, request, context):
+        start = time.perf_counter()
+        metrics.counter("auth.register.requests").inc()
+        await self._check_rate(context)
+        await self._validate_user_id(request.user_id, context)
+
+        if not request.y1 or not request.y2:
+            await context.abort(grpc.StatusCode.INVALID_ARGUMENT, "Empty y1 or y2 values")
+        if len(request.y1) > MAX_ELEMENT_WIRE or len(request.y2) > MAX_ELEMENT_WIRE:
+            await context.abort(grpc.StatusCode.INVALID_ARGUMENT, "y1 or y2 values too large")
+
+        try:
+            statement = self._parse_statement(request.y1, request.y2)
+        except errors.Error as e:
+            metrics.counter("auth.register.failure").inc()
+            await context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+
+        try:
+            await self.state.register_user(
+                UserData(
+                    user_id=request.user_id,
+                    statement=statement,
+                    registered_at=int(time.time()),
+                )
+            )
+        except errors.Error as e:
+            metrics.counter("auth.register.failure").inc()
+            metrics.histogram("auth.register.duration").observe(time.perf_counter() - start)
+            await context.abort(grpc.StatusCode.ALREADY_EXISTS, f"Registration failed: {e}")
+
+        metrics.counter("auth.register.success").inc()
+        metrics.histogram("auth.register.duration").observe(time.perf_counter() - start)
+        return self.pb2.RegistrationResponse(
+            success=True,
+            message=f"User '{request.user_id}' registered successfully",
+        )
+
+    async def register_batch(self, request, context):
+        start = time.perf_counter()
+        metrics.counter("auth.register_batch.requests").inc()
+        await self._check_rate(context)
+
+        n = len(request.user_ids)
+        if n == 0:
+            await context.abort(grpc.StatusCode.INVALID_ARGUMENT, "Empty batch")
+        if n != len(request.y1_values) or n != len(request.y2_values):
+            await context.abort(
+                grpc.StatusCode.INVALID_ARGUMENT, "Mismatched array lengths in batch request"
+            )
+        if n > MAX_BATCH:
+            await context.abort(
+                grpc.StatusCode.INVALID_ARGUMENT,
+                f"Batch size exceeds maximum limit of {MAX_BATCH}",
+            )
+        metrics.counter("auth.register_batch.users_count").inc(n)
+
+        results = []
+        for i in range(n):
+            user_id = request.user_ids[i]
+            y1b, y2b = request.y1_values[i], request.y2_values[i]
+
+            msg = _user_id_error(user_id)
+            if msg is None:
+                if not y1b or not y2b:
+                    msg = f"Empty y1 or y2 values for user {i}"
+                elif len(y1b) > MAX_ELEMENT_WIRE or len(y2b) > MAX_ELEMENT_WIRE:
+                    msg = f"y1 or y2 values too large for user {i}"
+            if msg is not None:
+                results.append(self.pb2.RegistrationResult(success=False, message=msg))
+                metrics.counter("auth.register_batch.individual_failure").inc()
+                continue
+
+            try:
+                statement = self._parse_statement(y1b, y2b)
+                await self.state.register_user(
+                    UserData(
+                        user_id=user_id,
+                        statement=statement,
+                        registered_at=int(time.time()),
+                    )
+                )
+            except errors.Error as e:
+                text = str(e)
+                if "already registered" in text or "capacity" in text:
+                    text = f"Registration failed: {text}"
+                results.append(self.pb2.RegistrationResult(success=False, message=text))
+                metrics.counter("auth.register_batch.individual_failure").inc()
+                continue
+
+            results.append(
+                self.pb2.RegistrationResult(
+                    success=True,
+                    message=f"User '{user_id}' registered successfully",
+                )
+            )
+            metrics.counter("auth.register_batch.individual_success").inc()
+
+        metrics.histogram("auth.register_batch.duration").observe(time.perf_counter() - start)
+        metrics.counter("auth.register_batch.success").inc()
+        return self.pb2.BatchRegistrationResponse(results=results)
+
+    async def create_challenge(self, request, context):
+        start = time.perf_counter()
+        metrics.counter("auth.challenge.requests").inc()
+        await self._check_rate(context)
+        await self._validate_user_id(request.user_id, context)
+
+        user = await self.state.get_user(request.user_id)
+        if user is None:
+            await context.abort(
+                grpc.StatusCode.NOT_FOUND, f"User '{request.user_id}' not found"
+            )
+
+        challenge_id = self.rng.fill_bytes(32)
+        try:
+            expires_at = await self.state.create_challenge(user.user_id, challenge_id)
+        except errors.Error as e:
+            metrics.counter("auth.challenge.failure").inc()
+            metrics.histogram("auth.challenge.duration").observe(time.perf_counter() - start)
+            await context.abort(
+                grpc.StatusCode.RESOURCE_EXHAUSTED, f"Challenge creation failed: {e}"
+            )
+
+        metrics.counter("auth.challenge.success").inc()
+        metrics.histogram("auth.challenge.duration").observe(time.perf_counter() - start)
+        return self.pb2.ChallengeResponse(challenge_id=challenge_id, expires_at=expires_at)
+
+    async def verify_proof(self, request, context):
+        start = time.perf_counter()
+        metrics.counter("auth.verify.requests").inc()
+        await self._check_rate(context)
+        await self._validate_user_id(request.user_id, context)
+
+        msg = _proof_args_error(request.challenge_id, request.proof)
+        if msg is not None:
+            await context.abort(grpc.StatusCode.INVALID_ARGUMENT, msg)
+
+        try:
+            challenge = await self.state.consume_challenge(request.challenge_id)
+        except errors.Error:
+            metrics.counter("auth.verify.failure").inc()
+            await context.abort(grpc.StatusCode.PERMISSION_DENIED, "Authentication failed")
+        if challenge.user_id != request.user_id:
+            metrics.counter("auth.verify.failure").inc()
+            await context.abort(grpc.StatusCode.PERMISSION_DENIED, "Authentication failed")
+
+        user = await self.state.get_user(request.user_id)
+        if user is None:
+            metrics.counter("auth.verify.failure").inc()
+            await context.abort(grpc.StatusCode.PERMISSION_DENIED, "Authentication failed")
+
+        try:
+            proof = Proof.from_bytes(request.proof)
+        except errors.Error as e:
+            metrics.counter("auth.verify.failure").inc()
+            await context.abort(grpc.StatusCode.INVALID_ARGUMENT, f"Invalid proof: {e}")
+
+        verifier = Verifier(Parameters.new(), user.statement)
+        transcript = Transcript()
+        transcript.append_context(request.challenge_id)
+        try:
+            verifier.verify_with_transcript(proof, transcript)
+        except errors.Error as e:
+            metrics.counter("auth.verify.failure").inc()
+            metrics.histogram("auth.verify.duration").observe(time.perf_counter() - start)
+            await context.abort(
+                grpc.StatusCode.PERMISSION_DENIED, f"Verification failed: {e}"
+            )
+
+        token = self.rng.fill_bytes(32).hex()
+        try:
+            await self.state.create_session(token, request.user_id)
+        except errors.Error as e:
+            metrics.counter("auth.verify.failure").inc()
+            metrics.histogram("auth.verify.duration").observe(time.perf_counter() - start)
+            await context.abort(grpc.StatusCode.INTERNAL, f"Failed to create session: {e}")
+
+        metrics.counter("auth.verify.success").inc()
+        metrics.histogram("auth.verify.duration").observe(time.perf_counter() - start)
+        return self.pb2.VerificationResponse(
+            success=True,
+            message=f"User '{request.user_id}' authenticated successfully",
+            session_token=token,
+        )
+
+    async def verify_proof_batch(self, request, context):
+        start = time.perf_counter()
+        metrics.counter("auth.verify_batch.requests").inc()
+        await self._check_rate(context)
+
+        n = len(request.user_ids)
+        if n == 0:
+            await context.abort(grpc.StatusCode.INVALID_ARGUMENT, "Empty batch")
+        if n != len(request.challenge_ids) or n != len(request.proofs):
+            await context.abort(
+                grpc.StatusCode.INVALID_ARGUMENT, "Mismatched array lengths in batch request"
+            )
+        if n > MAX_BATCH:
+            await context.abort(
+                grpc.StatusCode.INVALID_ARGUMENT,
+                f"Batch size exceeds maximum limit of {MAX_BATCH}",
+            )
+        metrics.counter("auth.verify_batch.proofs_count").inc(n)
+
+        batch = BatchVerifier(backend=self.backend)
+        contexts: list[str | None] = []  # user_id when queued, error message otherwise
+        error_msgs: list[str] = []
+        for i in range(n):
+            user_id = request.user_ids[i]
+            challenge_id = request.challenge_ids[i]
+            proof_bytes = request.proofs[i]
+
+            msg = _user_id_error(user_id)
+            if msg is None:
+                msg = _proof_args_error(challenge_id, proof_bytes, index=i)
+            if msg is not None:
+                contexts.append(None)
+                error_msgs.append(msg)
+                continue
+
+            # consume BEFORE verification — single-use even on failure
+            # (service.rs:478; docs/protocol.md:174-176)
+            try:
+                challenge = await self.state.consume_challenge(challenge_id)
+            except errors.Error:
+                contexts.append(None)
+                error_msgs.append("Authentication failed")
+                continue
+            if challenge.user_id != user_id:
+                contexts.append(None)
+                error_msgs.append("Authentication failed")
+                continue
+            user = await self.state.get_user(user_id)
+            if user is None:
+                contexts.append(None)
+                error_msgs.append("Authentication failed")
+                continue
+            try:
+                proof = Proof.from_bytes(proof_bytes)
+            except errors.Error as e:
+                contexts.append(None)
+                error_msgs.append(f"Invalid proof: {e}")
+                continue
+            try:
+                batch.add_with_context(
+                    Parameters.new(), user.statement, proof, bytes(challenge_id)
+                )
+            except errors.Error as e:
+                contexts.append(None)
+                error_msgs.append(f"Failed to add proof to batch: {e}")
+                continue
+            contexts.append(user_id)
+            error_msgs.append("")
+
+        batch_results: list = []
+        if len(batch) > 0:
+            try:
+                batch_results = batch.verify(self.rng)
+            except errors.Error as e:
+                metrics.counter("auth.verify_batch.failure").inc()
+                await context.abort(grpc.StatusCode.INTERNAL, f"Batch verification failed: {e}")
+
+        results = []
+        batch_index = 0
+        for i in range(n):
+            user_id = contexts[i]
+            if user_id is None:
+                results.append(
+                    self.pb2.VerificationResult(success=False, message=error_msgs[i])
+                )
+                metrics.counter("auth.verify_batch.individual_failure").inc()
+                continue
+            verify_err = batch_results[batch_index]
+            batch_index += 1
+            if verify_err is not None:
+                results.append(
+                    self.pb2.VerificationResult(success=False, message="Authentication failed")
+                )
+                metrics.counter("auth.verify_batch.individual_failure").inc()
+                continue
+            token = self.rng.fill_bytes(32).hex()
+            try:
+                await self.state.create_session(token, user_id)
+            except errors.Error as e:
+                results.append(
+                    self.pb2.VerificationResult(
+                        success=False, message=f"Failed to create session: {e}"
+                    )
+                )
+                metrics.counter("auth.verify_batch.individual_failure").inc()
+                continue
+            results.append(
+                self.pb2.VerificationResult(
+                    success=True,
+                    message=f"User '{user_id}' authenticated successfully",
+                    session_token=token,
+                )
+            )
+            metrics.counter("auth.verify_batch.individual_success").inc()
+
+        metrics.histogram("auth.verify_batch.duration").observe(time.perf_counter() - start)
+        metrics.counter("auth.verify_batch.success").inc()
+        return self.pb2.BatchVerificationResponse(results=results)
+
+
+def _user_id_error(user_id: str) -> str | None:
+    if not user_id:
+        return "User ID cannot be empty"
+    if len(user_id) > MAX_USER_ID_LEN:
+        return "User ID too long"
+    if not _valid_user_id_chars(user_id):
+        return "User ID contains invalid characters"
+    return None
+
+
+def _proof_args_error(challenge_id: bytes, proof: bytes, index: int | None = None) -> str | None:
+    sfx = "" if index is None else f" for proof {index}"
+    if not challenge_id:
+        return f"Empty challenge ID{sfx}"
+    if len(challenge_id) > MAX_CHALLENGE_ID:
+        return f"Challenge ID too long{sfx}"
+    if not proof:
+        return f"Empty proof{sfx}" if index is None else f"Empty proof {index}"
+    if len(proof) > MAX_PROOF_WIRE:
+        return f"Proof too large{sfx}" if index is None else f"Proof {index} too large"
+    return None
+
+
+def make_generic_handler(service: AuthServiceImpl) -> grpc.GenericRpcHandler:
+    """Register the five RPCs without generated *_pb2_grpc stubs."""
+    pb2 = service.pb2
+    types = method_types(pb2)
+    impl = {
+        "Register": service.register,
+        "RegisterBatch": service.register_batch,
+        "CreateChallenge": service.create_challenge,
+        "VerifyProof": service.verify_proof,
+        "VerifyProofBatch": service.verify_proof_batch,
+    }
+    handlers = {
+        name: grpc.unary_unary_rpc_method_handler(
+            impl[name],
+            request_deserializer=types[name][0].FromString,
+            response_serializer=types[name][1].SerializeToString,
+        )
+        for name in impl
+    }
+    return grpc.method_handlers_generic_handler(SERVICE_NAME, handlers)
+
+
+async def serve(
+    state: ServerState,
+    rate_limiter: RateLimiter,
+    host: str = "127.0.0.1",
+    port: int = 50051,
+    backend: VerifierBackend | None = None,
+    tls: tuple[bytes, bytes] | None = None,
+):
+    """Build and start an aio server; returns (server, bound_port).
+
+    ``tls`` is an optional (private_key_pem, cert_chain_pem) pair — wired
+    for real, unlike the reference where validated TLS settings never reach
+    the transport (SURVEY.md §3.3).
+    """
+    server = grpc.aio.server()
+    service = AuthServiceImpl(state, rate_limiter, backend=backend)
+    server.add_generic_rpc_handlers((make_generic_handler(service),))
+    health = _add_health_service(server)
+    server.health = health  # for shutdown: server.health.serving = False
+    addr = f"{host}:{port}"
+    if tls is not None:
+        creds = grpc.ssl_server_credentials([tls])
+        bound = server.add_secure_port(addr, creds)
+    else:
+        bound = server.add_insecure_port(addr)
+    await server.start()
+    return server, bound
+
+
+class HealthService:
+    """Standard gRPC health protocol, hand-wired (tonic-health twin,
+    bin/server.rs:208-211). ``set_serving(False)`` flips the whole server to
+    NOT_SERVING during graceful shutdown (bin/server.rs:420-422)."""
+
+    def __init__(self):
+        from .proto import load_health_pb2
+
+        self.pb2 = load_health_pb2()
+        self.serving = True
+
+    async def check(self, request, context):
+        del context
+        st = self.pb2.HealthCheckResponse.ServingStatus
+        return self.pb2.HealthCheckResponse(
+            status=st.SERVING if self.serving else st.NOT_SERVING
+        )
+
+    def handler(self) -> grpc.GenericRpcHandler:
+        return grpc.method_handlers_generic_handler(
+            "grpc.health.v1.Health",
+            {
+                "Check": grpc.unary_unary_rpc_method_handler(
+                    self.check,
+                    request_deserializer=self.pb2.HealthCheckRequest.FromString,
+                    response_serializer=self.pb2.HealthCheckResponse.SerializeToString,
+                )
+            },
+        )
+
+
+def _add_health_service(server) -> "HealthService":
+    health = HealthService()
+    server.add_generic_rpc_handlers((health.handler(),))
+    return health

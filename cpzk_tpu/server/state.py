@@ -1,0 +1,209 @@
+"""In-memory server state: users, single-use challenges, sessions.
+
+Reference parity (``src/verifier/state.rs``): same TTLs (challenge 300 s
+with a 2x-age clock-skew guard, session 3600 s), per-user caps (3
+challenges, 5 sessions), global caps (10k users / 50k challenges / 100k
+sessions), consume-once challenge semantics, and cleanup sweeps.
+
+Design deviation (deliberate): ONE ``asyncio.Lock`` guards all five maps.
+The reference takes five ``RwLock``s in inconsistent order between
+``create_challenge`` and ``consume_challenge`` (``state.rs:165-167`` vs
+``:205-206``) — a deadlock hazard under contention flagged in SURVEY.md §5;
+a single lock removes the hazard and is not a throughput bottleneck next to
+group operations.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from dataclasses import dataclass, field
+
+from ..errors import InvalidParams
+from ..protocol.gadgets import Statement
+
+CHALLENGE_EXPIRY_SECONDS = 300
+MAX_CHALLENGES_PER_USER = 3
+SESSION_EXPIRY_SECONDS = 3600
+MAX_SESSIONS_PER_USER = 5
+
+MAX_TOTAL_USERS = 10_000
+MAX_TOTAL_CHALLENGES = 50_000
+MAX_TOTAL_SESSIONS = 100_000
+
+
+def _now() -> int:
+    return int(time.time())
+
+
+@dataclass
+class UserData:
+    user_id: str
+    statement: Statement
+    registered_at: int
+
+
+@dataclass
+class ChallengeData:
+    challenge_id: bytes
+    user_id: str
+    created_at: int = field(default_factory=_now)
+    expires_at: int = 0
+
+    def __post_init__(self) -> None:
+        if not self.expires_at:
+            self.expires_at = self.created_at + CHALLENGE_EXPIRY_SECONDS
+
+    def is_expired(self) -> bool:
+        """TTL check with the reference's 2x-age clock-skew guard
+        (state.rs:101-111)."""
+        now = _now()
+        age = max(0, now - self.created_at)
+        return now >= self.expires_at or age >= 2 * CHALLENGE_EXPIRY_SECONDS
+
+
+@dataclass
+class SessionData:
+    token: str
+    user_id: str
+    created_at: int = field(default_factory=_now)
+    expires_at: int = 0
+
+    def __post_init__(self) -> None:
+        if not self.expires_at:
+            self.expires_at = self.created_at + SESSION_EXPIRY_SECONDS
+
+    def is_expired(self) -> bool:
+        return _now() >= self.expires_at
+
+
+class ServerState:
+    """All server registries behind one lock (see module docstring)."""
+
+    def __init__(self) -> None:
+        self._lock = asyncio.Lock()
+        self._users: dict[str, UserData] = {}
+        self._challenges: dict[bytes, ChallengeData] = {}
+        self._user_challenges: dict[str, list[bytes]] = {}
+        self._sessions: dict[str, SessionData] = {}
+        self._user_sessions: dict[str, list[str]] = {}
+
+    # --- users (state.rs:136-161) ---
+
+    async def register_user(self, user_data: UserData) -> None:
+        async with self._lock:
+            if len(self._users) >= MAX_TOTAL_USERS:
+                raise InvalidParams(
+                    f"Server has reached maximum user capacity ({MAX_TOTAL_USERS})"
+                )
+            if user_data.user_id in self._users:
+                raise InvalidParams(f"User '{user_data.user_id}' already registered")
+            self._users[user_data.user_id] = user_data
+
+    async def get_user(self, user_id: str) -> UserData | None:
+        async with self._lock:
+            return self._users.get(user_id)
+
+    # --- challenges (state.rs:164-249) ---
+
+    async def create_challenge(self, user_id: str, challenge_id: bytes) -> int:
+        async with self._lock:
+            if len(self._challenges) >= MAX_TOTAL_CHALLENGES:
+                raise InvalidParams(
+                    f"Server has reached maximum challenge capacity ({MAX_TOTAL_CHALLENGES})"
+                )
+            if user_id not in self._users:
+                raise InvalidParams(f"User '{user_id}' not found")
+            per_user = self._user_challenges.setdefault(user_id, [])
+            if len(per_user) >= MAX_CHALLENGES_PER_USER:
+                raise InvalidParams(f"Too many active challenges for user '{user_id}'")
+            data = ChallengeData(challenge_id=challenge_id, user_id=user_id)
+            per_user.append(challenge_id)
+            self._challenges[challenge_id] = data
+            return data.expires_at
+
+    async def get_challenge(self, challenge_id: bytes) -> ChallengeData | None:
+        async with self._lock:
+            return self._challenges.get(challenge_id)
+
+    async def consume_challenge(self, challenge_id: bytes) -> ChallengeData:
+        """Single-use removal; expired challenges are removed AND rejected."""
+        async with self._lock:
+            data = self._challenges.get(challenge_id)
+            if data is None:
+                raise InvalidParams("Invalid or expired challenge")
+            del self._challenges[challenge_id]
+            per_user = self._user_challenges.get(data.user_id)
+            if per_user is not None and challenge_id in per_user:
+                per_user.remove(challenge_id)
+            if data.is_expired():
+                raise InvalidParams("Invalid or expired challenge")
+            return data
+
+    async def cleanup_expired_challenges(self) -> int:
+        async with self._lock:
+            expired = [cid for cid, d in self._challenges.items() if d.is_expired()]
+            for cid in expired:
+                data = self._challenges.pop(cid)
+                per_user = self._user_challenges.get(data.user_id)
+                if per_user is not None and cid in per_user:
+                    per_user.remove(cid)
+            return len(expired)
+
+    # --- sessions (state.rs:252-327) ---
+
+    async def create_session(self, token: str, user_id: str) -> None:
+        async with self._lock:
+            if len(self._sessions) >= MAX_TOTAL_SESSIONS:
+                raise InvalidParams(
+                    f"Server has reached maximum session capacity ({MAX_TOTAL_SESSIONS})"
+                )
+            per_user = self._user_sessions.setdefault(user_id, [])
+            if len(per_user) >= MAX_SESSIONS_PER_USER:
+                raise InvalidParams(
+                    f"User '{user_id}' has reached maximum session limit ({MAX_SESSIONS_PER_USER})"
+                )
+            self._sessions[token] = SessionData(token=token, user_id=user_id)
+            per_user.append(token)
+
+    async def validate_session(self, token: str) -> str:
+        async with self._lock:
+            data = self._sessions.get(token)
+            if data is None:
+                raise InvalidParams("Invalid session token")
+            if data.is_expired():
+                raise InvalidParams("Session expired")
+            return data.user_id
+
+    async def revoke_session(self, token: str) -> None:
+        async with self._lock:
+            data = self._sessions.pop(token, None)
+            if data is None:
+                raise InvalidParams("Session not found")
+            per_user = self._user_sessions.get(data.user_id)
+            if per_user is not None and token in per_user:
+                per_user.remove(token)
+
+    async def cleanup_expired_sessions(self) -> int:
+        async with self._lock:
+            expired = [t for t, d in self._sessions.items() if d.is_expired()]
+            for t in expired:
+                data = self._sessions.pop(t)
+                per_user = self._user_sessions.get(data.user_id)
+                if per_user is not None and t in per_user:
+                    per_user.remove(t)
+            return len(expired)
+
+    # --- counts (state.rs:330-342) ---
+
+    async def user_count(self) -> int:
+        async with self._lock:
+            return len(self._users)
+
+    async def session_count(self) -> int:
+        async with self._lock:
+            return len(self._sessions)
+
+    async def challenge_count(self) -> int:
+        async with self._lock:
+            return len(self._challenges)

@@ -1,0 +1,212 @@
+"""Batch RPC integration tests — reference ``tests/batch_verification_tests.rs``
+twins (multi-valid, mixed validity, malformed batches, batch registration,
+large batch)."""
+
+import asyncio
+
+import pytest
+
+import grpc
+
+from cpzk_tpu import Parameters, Prover, SecureRng, Transcript, Witness
+from cpzk_tpu.client import AuthClient
+from cpzk_tpu.core.ristretto import Ristretto255
+from cpzk_tpu.server import RateLimiter, ServerState
+from cpzk_tpu.server.service import serve
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+async def start():
+    state = ServerState()
+    server, port = await serve(state, RateLimiter(10_000, 10_000), host="127.0.0.1", port=0)
+    return state, server, port
+
+
+async def register_users(client, n, prefix="user"):
+    rng = SecureRng()
+    users = []
+    for i in range(n):
+        user_id = f"{prefix}{i}"
+        prover = Prover(Parameters.new(), Witness(Ristretto255.random_scalar(rng)))
+        resp = await client.register(
+            user_id,
+            Ristretto255.element_to_bytes(prover.statement.y1),
+            Ristretto255.element_to_bytes(prover.statement.y2),
+        )
+        assert resp.success
+        users.append((user_id, prover))
+    return users
+
+
+async def challenge_and_prove(client, users, wrong_context_for=()):
+    rng = SecureRng()
+    ids, cids, proofs = [], [], []
+    for idx, (user_id, prover) in enumerate(users):
+        ch = await client.create_challenge(user_id)
+        cid = bytes(ch.challenge_id)
+        t = Transcript()
+        if idx in wrong_context_for:
+            t.append_context(b"wrong-context")
+        else:
+            t.append_context(cid)
+        proofs.append(prover.prove_with_transcript(rng, t).to_bytes())
+        ids.append(user_id)
+        cids.append(cid)
+    return ids, cids, proofs
+
+
+def test_batch_verify_all_valid():
+    async def flow():
+        _, server, port = await start()
+        try:
+            async with AuthClient(f"127.0.0.1:{port}") as client:
+                users = await register_users(client, 5, "bv")
+                ids, cids, proofs = await challenge_and_prove(client, users)
+                resp = await client.verify_proof_batch(ids, cids, proofs)
+                assert len(resp.results) == 5
+                for r in resp.results:
+                    assert r.success and r.session_token
+        finally:
+            await server.stop(None)
+
+    run(flow())
+
+
+def test_batch_verify_mixed_validity():
+    async def flow():
+        _, server, port = await start()
+        try:
+            async with AuthClient(f"127.0.0.1:{port}") as client:
+                users = await register_users(client, 6, "mx")
+                ids, cids, proofs = await challenge_and_prove(
+                    client, users, wrong_context_for={1, 4}
+                )
+                resp = await client.verify_proof_batch(ids, cids, proofs)
+                outcomes = [r.success for r in resp.results]
+                assert outcomes == [True, False, True, True, False, True]
+                assert resp.results[1].message == "Authentication failed"
+        finally:
+            await server.stop(None)
+
+    run(flow())
+
+
+def test_batch_rejects_malformed():
+    async def flow():
+        _, server, port = await start()
+        try:
+            async with AuthClient(f"127.0.0.1:{port}") as client:
+                with pytest.raises(grpc.aio.AioRpcError) as exc:
+                    await client.verify_proof_batch([], [], [])
+                assert "Empty batch" in exc.value.details()
+
+                with pytest.raises(grpc.aio.AioRpcError) as exc:
+                    await client.verify_proof_batch(["a"], [], [])
+                assert "Mismatched array lengths" in exc.value.details()
+
+                big = ["u"] * 1001
+                with pytest.raises(grpc.aio.AioRpcError) as exc:
+                    await client.verify_proof_batch(big, [b"c"] * 1001, [b"p"] * 1001)
+                assert "maximum limit of 1000" in exc.value.details()
+        finally:
+            await server.stop(None)
+
+    run(flow())
+
+
+def test_batch_single_proof():
+    async def flow():
+        _, server, port = await start()
+        try:
+            async with AuthClient(f"127.0.0.1:{port}") as client:
+                users = await register_users(client, 1, "solo")
+                ids, cids, proofs = await challenge_and_prove(client, users)
+                resp = await client.verify_proof_batch(ids, cids, proofs)
+                assert len(resp.results) == 1 and resp.results[0].success
+        finally:
+            await server.stop(None)
+
+    run(flow())
+
+
+def test_batch_registration_with_duplicates():
+    async def flow():
+        _, server, port = await start()
+        try:
+            async with AuthClient(f"127.0.0.1:{port}") as client:
+                rng = SecureRng()
+                provers = [
+                    Prover(Parameters.new(), Witness(Ristretto255.random_scalar(rng)))
+                    for _ in range(3)
+                ]
+                ids = ["br0", "br1", "br0"]  # duplicate in one batch
+                y1s = [Ristretto255.element_to_bytes(p.statement.y1) for p in provers]
+                y2s = [Ristretto255.element_to_bytes(p.statement.y2) for p in provers]
+                resp = await client.register_batch(ids, y1s, y2s)
+                assert [r.success for r in resp.results] == [True, True, False]
+                assert "already registered" in resp.results[2].message
+
+                # bad element bytes -> per-item failure, batch still succeeds
+                resp = await client.register_batch(
+                    ["br2", "br3"], [b"\x00" * 32, y1s[0]], [y2s[0], b"garbage" + b"\x00" * 25]
+                )
+                assert [r.success for r in resp.results] == [False, False]
+        finally:
+            await server.stop(None)
+
+    run(flow())
+
+
+def test_batch_challenge_consumed_even_on_failure():
+    """Challenges are consumed atomically BEFORE verification
+    (service.rs:478; docs/protocol.md:174-176)."""
+
+    async def flow():
+        state, server, port = await start()
+        try:
+            async with AuthClient(f"127.0.0.1:{port}") as client:
+                users = await register_users(client, 2, "cc")
+                ids, cids, proofs = await challenge_and_prove(
+                    client, users, wrong_context_for={0}
+                )
+                resp = await client.verify_proof_batch(ids, cids, proofs)
+                assert [r.success for r in resp.results] == [False, True]
+                assert await state.challenge_count() == 0  # both consumed
+        finally:
+            await server.stop(None)
+
+    run(flow())
+
+
+def test_large_batch_100_users():
+    async def flow():
+        _, server, port = await start()
+        try:
+            async with AuthClient(f"127.0.0.1:{port}") as client:
+                rng = SecureRng()
+                provers = [
+                    Prover(Parameters.new(), Witness(Ristretto255.random_scalar(rng)))
+                    for _ in range(100)
+                ]
+                ids = [f"big{i}" for i in range(100)]
+                resp = await client.register_batch(
+                    ids,
+                    [Ristretto255.element_to_bytes(p.statement.y1) for p in provers],
+                    [Ristretto255.element_to_bytes(p.statement.y2) for p in provers],
+                )
+                assert all(r.success for r in resp.results)
+
+                users = list(zip(ids, provers))
+                bids, cids, proofs = await challenge_and_prove(client, users)
+                resp = await client.verify_proof_batch(bids, cids, proofs)
+                assert len(resp.results) == 100
+                assert all(r.success for r in resp.results)
+                tokens = {r.session_token for r in resp.results}
+                assert len(tokens) == 100  # unique sessions
+        finally:
+            await server.stop(None)
+
+    run(flow())

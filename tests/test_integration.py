@@ -1,0 +1,184 @@
+"""gRPC integration tests — reference ``tests/integration_tests.rs`` twins.
+
+Fixture boots a real asyncio gRPC server on a loopback OS-assigned port
+(the reference's fake-backend stand-in, SURVEY.md §4) and drives it with
+the hand-wired AuthClient.
+"""
+
+import asyncio
+
+import pytest
+
+from cpzk_tpu import Parameters, Prover, SecureRng, Transcript, Witness
+from cpzk_tpu.client import AuthClient
+from cpzk_tpu.client.kdf import password_to_scalar
+from cpzk_tpu.core.ristretto import Ristretto255
+from cpzk_tpu.server import RateLimiter, ServerState
+from cpzk_tpu.server.service import serve
+
+import grpc
+
+
+@pytest.fixture()
+def anyio_backend():
+    return "asyncio"
+
+
+async def start_test_server(rate: int = 10_000, burst: int = 10_000):
+    state = ServerState()
+    server, port = await serve(state, RateLimiter(rate, burst), host="127.0.0.1", port=0)
+    return state, server, port
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def register_and_login_flow(user: str, password: str):
+    async def flow():
+        _, server, port = await start_test_server()
+        try:
+            async with AuthClient(f"127.0.0.1:{port}") as client:
+                # register (reference flow: derive statement from password)
+                x = password_to_scalar(password, user)
+                params = Parameters.new()
+                prover = Prover(params, Witness(x))
+                st = prover.statement
+                resp = await client.register(
+                    user,
+                    Ristretto255.element_to_bytes(st.y1),
+                    Ristretto255.element_to_bytes(st.y2),
+                )
+                assert resp.success
+
+                # challenge -> prove with challenge-id context -> verify
+                ch = await client.create_challenge(user)
+                assert len(ch.challenge_id) == 32
+                t = Transcript()
+                t.append_context(bytes(ch.challenge_id))
+                proof = prover.prove_with_transcript(SecureRng(), t)
+                v = await client.verify_proof(user, bytes(ch.challenge_id), proof.to_bytes())
+                assert v.success
+                assert v.session_token and len(v.session_token) == 64
+                return True
+        finally:
+            await server.stop(None)
+
+    assert run(flow())
+
+
+def test_full_auth_flow():
+    register_and_login_flow("alice", "correct-horse")
+
+
+def test_duplicate_registration():
+    async def flow():
+        _, server, port = await start_test_server()
+        try:
+            async with AuthClient(f"127.0.0.1:{port}") as client:
+                rng = SecureRng()
+                prover = Prover(Parameters.new(), Witness(Ristretto255.random_scalar(rng)))
+                y1 = Ristretto255.element_to_bytes(prover.statement.y1)
+                y2 = Ristretto255.element_to_bytes(prover.statement.y2)
+                assert (await client.register("bob", y1, y2)).success
+                with pytest.raises(grpc.aio.AioRpcError) as exc:
+                    await client.register("bob", y1, y2)
+                assert exc.value.code() == grpc.StatusCode.ALREADY_EXISTS
+        finally:
+            await server.stop(None)
+
+    run(flow())
+
+
+def test_challenge_single_use():
+    async def flow():
+        _, server, port = await start_test_server()
+        try:
+            async with AuthClient(f"127.0.0.1:{port}") as client:
+                rng = SecureRng()
+                prover = Prover(Parameters.new(), Witness(Ristretto255.random_scalar(rng)))
+                await client.register(
+                    "carol",
+                    Ristretto255.element_to_bytes(prover.statement.y1),
+                    Ristretto255.element_to_bytes(prover.statement.y2),
+                )
+                ch = await client.create_challenge("carol")
+                cid = bytes(ch.challenge_id)
+                t = Transcript()
+                t.append_context(cid)
+                proof = prover.prove_with_transcript(rng, t)
+                assert (await client.verify_proof("carol", cid, proof.to_bytes())).success
+                # replay: challenge consumed -> PERMISSION_DENIED, opaque message
+                with pytest.raises(grpc.aio.AioRpcError) as exc:
+                    await client.verify_proof("carol", cid, proof.to_bytes())
+                assert exc.value.code() == grpc.StatusCode.PERMISSION_DENIED
+                assert exc.value.details() == "Authentication failed"
+        finally:
+            await server.stop(None)
+
+    run(flow())
+
+
+def test_wrong_password_rejected():
+    async def flow():
+        _, server, port = await start_test_server()
+        try:
+            async with AuthClient(f"127.0.0.1:{port}") as client:
+                right = Prover(Parameters.new(), Witness(password_to_scalar("pw", "dave")))
+                await client.register(
+                    "dave",
+                    Ristretto255.element_to_bytes(right.statement.y1),
+                    Ristretto255.element_to_bytes(right.statement.y2),
+                )
+                wrong = Prover(Parameters.new(), Witness(password_to_scalar("bad", "dave")))
+                ch = await client.create_challenge("dave")
+                cid = bytes(ch.challenge_id)
+                t = Transcript()
+                t.append_context(cid)
+                proof = wrong.prove_with_transcript(SecureRng(), t)
+                with pytest.raises(grpc.aio.AioRpcError) as exc:
+                    await client.verify_proof("dave", cid, proof.to_bytes())
+                assert exc.value.code() == grpc.StatusCode.PERMISSION_DENIED
+        finally:
+            await server.stop(None)
+
+    run(flow())
+
+
+def test_max_three_challenges():
+    async def flow():
+        _, server, port = await start_test_server()
+        try:
+            async with AuthClient(f"127.0.0.1:{port}") as client:
+                rng = SecureRng()
+                prover = Prover(Parameters.new(), Witness(Ristretto255.random_scalar(rng)))
+                await client.register(
+                    "erin",
+                    Ristretto255.element_to_bytes(prover.statement.y1),
+                    Ristretto255.element_to_bytes(prover.statement.y2),
+                )
+                for _ in range(3):
+                    await client.create_challenge("erin")
+                with pytest.raises(grpc.aio.AioRpcError) as exc:
+                    await client.create_challenge("erin")
+                assert exc.value.code() == grpc.StatusCode.RESOURCE_EXHAUSTED
+        finally:
+            await server.stop(None)
+
+    run(flow())
+
+
+def test_health_endpoint():
+    async def flow():
+        _, server, port = await start_test_server()
+        try:
+            async with AuthClient(f"127.0.0.1:{port}") as client:
+                resp = await client.health_check()
+                assert resp.status == 1  # SERVING
+                server.health.serving = False
+                resp = await client.health_check()
+                assert resp.status == 2  # NOT_SERVING
+        finally:
+            await server.stop(None)
+
+    run(flow())
