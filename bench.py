@@ -62,8 +62,8 @@ def main() -> None:
     reps = (N + corpus - 1) // corpus
     rows = (rows * reps)[:N]
 
-    g = tuple(c[0] for c in curve.points_to_device([params.generator_g.point]))
-    h = tuple(c[0] for c in curve.points_to_device([params.generator_h.point]))
+    g = curve.points_to_device([params.generator_g.point])  # [20, 1], broadcasts
+    h = curve.points_to_device([params.generator_h.point])
     y1 = _points_soa([st.y1.point for st, _, _ in rows], N)
     y2 = _points_soa([st.y2.point for st, _, _ in rows], N)
     r1 = _points_soa([pr.commitment.r1.point for _, pr, _ in rows], N)

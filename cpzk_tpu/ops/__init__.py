@@ -3,7 +3,7 @@
 This package is the TPU-native re-design of the compute that the reference
 delegates to curve25519-dalek (SURVEY.md §2.2): field arithmetic, point
 arithmetic, and batch verification — expressed as vectorized operations over
-``[batch, NLIMBS]`` int32 limb arrays so XLA can tile them onto the TPU's
-vector units, with `jax.sharding` handling multi-chip scale (see
+limb-major ``[NLIMBS, batch]`` int32 arrays — the batch axis rides the
+128-wide vector lanes, with `jax.sharding` handling multi-chip scale (see
 :mod:`cpzk_tpu.parallel`).
 """

@@ -2,10 +2,16 @@
 :class:`cpzk_tpu.protocol.batch.BatchVerifier`.
 
 Host side: scalar arithmetic mod l (Python ints are exact and cheap relative
-to group ops), 4-bit window decomposition, and SoA limb marshalling of the
-row points.  Device side: the batched kernels in :mod:`cpzk_tpu.ops.verify`.
-Batch shapes are padded to powers of two so ``jax.jit`` caches a handful of
-programs instead of one per batch size.
+to group ops), window/digit decomposition, and SoA limb marshalling of the
+row points.  Device side: the batched kernels in :mod:`cpzk_tpu.ops.verify`
+and the windowed-Pippenger MSM in :mod:`cpzk_tpu.ops.msm`.  Batch shapes
+are padded to powers of two so ``jax.jit`` caches a handful of programs
+instead of one per batch size.
+
+The combined RLC check dispatches by size: small batches use the per-row
+shared-doubling kernel (table-build overhead amortizes badly), large ones
+the Pippenger MSM over all 4n+2 terms, whose per-term cost falls with batch
+size (see ``ops/msm.py``).
 
 Semantics parity (reference ``src/verifier/batch.rs``): the combined check
 is only an accelerator — on failure ``BatchVerifier`` falls back to
@@ -26,7 +32,12 @@ from ..core import edwards
 from ..core.ristretto import Ristretto255, Scalar
 from ..core.scalars import L
 from ..protocol.batch import BatchRow, VerifierBackend
-from . import curve, verify
+from . import curve, msm, verify
+
+#: Row count at or above which the combined check uses the Pippenger MSM
+#: instead of per-row windowed chains (crossover from the cost model in
+#: ``msm.pick_window``; below this the per-row kernel's 570 ops/row win).
+PIPPENGER_MIN_ROWS = 32
 
 
 def _pad_pow2(n: int) -> int:
@@ -58,6 +69,11 @@ def _combined(n_pad, r1, y1, r2, y2, w_a, w_ac, w_ba, w_bac):
     return verify.combined_kernel(r1, y1, r2, y2, w_a, w_ac, w_ba, w_bac)
 
 
+@partial(jax.jit, static_argnums=(0,))
+def _msm_identity(c, points, digits):
+    return msm.msm_is_identity_kernel(points, digits, c)
+
+
 class TpuBackend(VerifierBackend):
     """Vectorized device backend (TPU when available, any JAX backend)."""
 
@@ -72,13 +88,11 @@ class TpuBackend(VerifierBackend):
             Ristretto255.element_to_bytes(row.h),
         )
         if key not in self._gh_cache:
+            # single shared points keep a size-1 batch axis ([20, 1] coords)
+            # and broadcast against the [20, n] row arrays
             self._gh_cache[key] = (
                 curve.points_to_device([row.g.point]),
                 curve.points_to_device([row.h.point]),
-            )
-            # single-point tables: squeeze the batch axis -> [20] coords
-            self._gh_cache[key] = tuple(
-                tuple(c[0] for c in pt) for pt in self._gh_cache[key]
             )
         return self._gh_cache[key]
 
@@ -95,6 +109,9 @@ class TpuBackend(VerifierBackend):
         bac = [b * x % L for x in ac]
         sum_as = sum(x * y for x, y in zip(a, s)) % L
 
+        if n >= PIPPENGER_MIN_ROWS:
+            return self._combined_pippenger(rows, a, ac, ba, bac, b, sum_as)
+
         # correction row: G in slot r1 with -sum(a s), H in slot y1 with
         # -b sum(a s); identity in the other two slots.
         g, h = rows[0].g.point, rows[0].h.point
@@ -110,6 +127,33 @@ class TpuBackend(VerifierBackend):
 
         ok = _combined(pad, r1, y1, r2, y2, w_a, w_ac, w_ba, w_bac)
         return bool(ok)
+
+    def _combined_pippenger(
+        self,
+        rows: list[BatchRow],
+        a: list[int],
+        ac: list[int],
+        ba: list[int],
+        bac: list[int],
+        b: int,
+        sum_as: int,
+    ) -> bool:
+        """One MSM over all 4n+2 (point, scalar) terms == identity."""
+        points = (
+            [r.r1.point for r in rows]
+            + [r.y1.point for r in rows]
+            + [r.r2.point for r in rows]
+            + [r.y2.point for r in rows]
+            + [rows[0].g.point, rows[0].h.point]
+        )
+        scalars = a + ac + ba + bac + [(L - sum_as) % L, (L - b * sum_as % L) % L]
+        m = _pad_pow2(len(points))
+        c = msm.pick_window(m)
+        pts = _points_soa(points, m)
+        digits = jnp.asarray(
+            msm.scalars_to_signed_digits(scalars + [0] * (m - len(scalars)), c)
+        )
+        return bool(_msm_identity(c, pts, digits))
 
     def verify_each(self, rows: list[BatchRow]) -> list[bool]:
         n = len(rows)

@@ -1,10 +1,11 @@
 """Batched edwards25519 / ristretto255 point kernels (JAX).
 
 Points are structure-of-arrays extended coordinates: a tuple
-``(X, Y, Z, T)`` of ``[..., 20]`` int32 limb arrays (x = X/Z, y = Y/Z,
-T = XY/Z).  Everything is batched over leading axes and shardable along
-them; no data-dependent control flow (masks/selects only), so the whole
-thing stays inside one XLA program.
+``(X, Y, Z, T)`` of ``[20, ...batch]`` int32 limb arrays (x = X/Z, y = Y/Z,
+T = XY/Z).  The limb axis leads and the batch axes trail so the batch rides
+the TPU vector lanes (see :mod:`cpzk_tpu.ops.limbs`).  Everything is batched
+over trailing axes and shardable along them; no data-dependent control flow
+(masks/selects only), so the whole thing stays inside one XLA program.
 
 Re-design (not a port) of the point layer that curve25519-dalek provides
 under the reference's ``src/primitives/ristretto.rs`` (SURVEY.md §2.2):
@@ -19,6 +20,11 @@ under the reference's ``src/primitives/ristretto.rs`` (SURVEY.md §2.2):
   precomputed tables — scalars are public verification inputs here
   (vartime is fine; see docs/security.md)
 - batch tree-reduction point sum for the combined RLC check
+
+Table lookups use a bitwise select tree (4 levels of lane-masked where)
+instead of gather HLOs: every step is a pure vector op with the batch on the
+lanes, so the lookup cost is deterministic on TPU regardless of how XLA
+would lower a lane-crossing gather.
 """
 
 from __future__ import annotations
@@ -44,7 +50,7 @@ TABLE = 1 << WINDOW_BITS
 # ---------------------------------------------------------------------------
 
 def points_to_device(points: list[host_edwards.Point]) -> Point:
-    """Host extended-coordinate points -> SoA limb arrays [n, 20] x 4."""
+    """Host extended-coordinate points -> SoA limb arrays [20, n] x 4."""
     xs = limbs.ints_to_limbs([p[0] for p in points])
     ys = limbs.ints_to_limbs([p[1] for p in points])
     zs = limbs.ints_to_limbs([p[2] for p in points])
@@ -58,8 +64,10 @@ def points_from_device(pt: Point) -> list[host_edwards.Point]:
 
 
 def identity(shape: tuple[int, ...] = ()) -> Point:
-    z = jnp.zeros(shape + (NLIMBS,), dtype=jnp.int32)
-    one = jnp.broadcast_to(limbs.ONE, shape + (NLIMBS,))
+    z = jnp.zeros((NLIMBS,) + shape, dtype=jnp.int32)
+    one = jnp.broadcast_to(
+        limbs.ONE[:, 0].reshape((NLIMBS,) + (1,) * len(shape)), (NLIMBS,) + shape
+    )
     return (z, one, one, z)
 
 
@@ -102,8 +110,14 @@ def negate(p: Point) -> Point:
 
 
 def select(mask: jnp.ndarray, p: Point, q: Point) -> Point:
-    """Lane-wise where(mask, p, q); mask shaped [...] (no limb axis)."""
+    """Lane-wise where(mask, p, q); mask shaped [...batch] (no limb axis)."""
     return tuple(limbs.select(mask, a, b) for a, b in zip(p, q))
+
+
+def cond_negate(mask: jnp.ndarray, p: Point) -> Point:
+    """Lane-wise negate where mask is set (cheap: negate X and T)."""
+    X, Y, Z, T = p
+    return (jnp.where(mask, -X, X), Y, Z, jnp.where(mask, -T, T))
 
 
 def eq(p: Point, q: Point) -> jnp.ndarray:
@@ -128,8 +142,9 @@ def is_identity(p: Point) -> jnp.ndarray:
 # ---------------------------------------------------------------------------
 
 def scalars_to_windows(values: list[int]) -> np.ndarray:
-    """Host: scalars (already reduced mod l) -> [n, 64] int32 of 4-bit
-    windows, most-significant window first."""
+    """Host: scalars (already reduced mod l) -> [64, n] int32 of 4-bit
+    windows, most-significant window first (window axis leading, to match
+    the device layout convention)."""
     blob = b"".join(int(v).to_bytes(32, "little") for v in values)
     raw = np.frombuffer(blob, dtype=np.uint8).reshape(len(values), 32)
     lo = raw & 0x0F
@@ -137,68 +152,81 @@ def scalars_to_windows(values: list[int]) -> np.ndarray:
     nibbles = np.empty((len(values), NWINDOWS), dtype=np.int32)
     nibbles[:, 0::2] = lo
     nibbles[:, 1::2] = hi
-    return nibbles[:, ::-1]  # MSB window first
+    return np.ascontiguousarray(nibbles[:, ::-1].T)  # [64, n], MSB first
 
 
-def _table_gather(table: tuple[jnp.ndarray, ...], idx: jnp.ndarray) -> Point:
-    """table coords are [..., TABLE, 20]; idx is [...] -> Point [..., 20]."""
-    idxe = idx[..., None, None]
+def build_table(p: Point) -> tuple[jnp.ndarray, ...]:
+    """[0..15] * p as stacked coords: 4 x [16, 20, ...batch].
+
+    Built with a lax.scan of 14 batched adds so the XLA graph stays small.
+    """
+    def step(acc: Point, _):
+        nxt = add(acc, p)
+        return nxt, nxt
+
+    _, rest = lax.scan(step, p, None, length=TABLE - 2)  # coords [14, 20, ...]
+    ident = identity(p[0].shape[1:])
     return tuple(
-        jnp.take_along_axis(c, jnp.broadcast_to(idxe, idx.shape + (1, NLIMBS)), axis=-2)[
-            ..., 0, :
-        ]
-        for c in table
+        jnp.concatenate([ident[i][None], p[i][None], rest[i]], axis=0)
+        for i in range(4)
     )
+
+
+def table_gather(table: tuple[jnp.ndarray, ...], idx: jnp.ndarray) -> Point:
+    """Select table[idx] per lane via a 4-level bit select tree.
+
+    ``table`` coords are [16, 20, ...batch] (batch may be size-1 for shared
+    tables); ``idx`` is [...batch] in [0, 16).  15 lane-masked selects per
+    coordinate — all pure vector ops, no gather HLO.
+    """
+    out = []
+    for c in table:
+        t = c
+        for k in range(WINDOW_BITS):
+            bit = ((idx >> k) & 1).astype(jnp.bool_)
+            t = jnp.where(bit, t[1::2], t[0::2])
+        out.append(t[0])
+    return tuple(out)
 
 
 def scalar_mul(p: Point, windows: jnp.ndarray) -> Point:
-    """Batched windowed double-and-add: [..., 20]-point ** [..., 64]-windows.
+    """Batched windowed double-and-add: [20, ...]-point ** [64, ...]-windows.
 
-    Per lane: precompute table [0..15]*P (15 batched adds), then 64 steps of
-    4 doublings + one gathered table add.  ~255 doubles + 79 adds per lane,
+    Per lane: precompute table [0..15]*P (14 batched adds), then 64 steps of
+    4 doublings + one selected table add.  ~255 doubles + 79 adds per lane,
     fully vectorized across the batch; variable-base, variable-time in the
     *public* scalar only (verification inputs).
     """
-    # table[k] = k * P, coords stacked on axis -2: [..., 16, 20]
-    tbl = [identity(windows.shape[:-1]), p]
-    for _ in range(TABLE - 2):
-        tbl.append(add(tbl[-1], p))
-    table = tuple(
-        jnp.stack([t[i] for t in tbl], axis=-2) for i in range(4)
-    )
+    table = build_table(p)
 
     def step(acc: Point, w: jnp.ndarray) -> tuple[Point, None]:
         for _ in range(WINDOW_BITS):
             acc = double(acc)
-        return add(acc, _table_gather(table, w)), None
+        return add(acc, table_gather(table, w)), None
 
-    # scan over the window axis (time-major): move windows to axis 0
-    wT = jnp.moveaxis(windows, -1, 0)  # [64, ...]
-    acc0 = identity(windows.shape[:-1])
-    acc, _ = lax.scan(lambda a, w: step(a, w), acc0, wT)
+    acc0 = identity(windows.shape[1:])
+    acc, _ = lax.scan(step, acc0, windows)
     return acc
 
 
-def tree_sum(p: Point, axis: int = 0) -> Point:
-    """Reduce-sum of points along ``axis`` by halving (log2 n batched adds).
-
-    Pads to a power of two with identity points.
-    """
-    n = p[0].shape[axis]
-    coords = [jnp.moveaxis(c, axis, 0) for c in p]
+def tree_sum(p: Point, axis: int = -1) -> Point:
+    """Reduce-sum of points along a batch ``axis`` by halving (log2 n
+    batched adds).  Pads to a power of two with identity points."""
+    coords = [jnp.moveaxis(c, axis if axis >= 0 else c.ndim + axis, 1) for c in p]
+    n = coords[0].shape[1]
     size = 1
     while size < n:
         size *= 2
     if size != n:
-        pad = identity((size - n,) + coords[0].shape[1:-1])
-        coords = [jnp.concatenate([c, pc], axis=0) for c, pc in zip(coords, pad)]
+        pad = identity((size - n,) + coords[0].shape[2:])
+        coords = [jnp.concatenate([c, pc], axis=1) for c, pc in zip(coords, pad)]
     pt = tuple(coords)
-    while pt[0].shape[0] > 1:
-        half = pt[0].shape[0] // 2
-        a = tuple(c[:half] for c in pt)
-        b = tuple(c[half:] for c in pt)
+    while pt[0].shape[1] > 1:
+        half = pt[0].shape[1] // 2
+        a = tuple(c[:, :half] for c in pt)
+        b = tuple(c[:, half:] for c in pt)
         pt = add(a, b)
-    return tuple(c[0] for c in pt)
+    return tuple(c[:, 0] for c in pt)
 
 
 # ---------------------------------------------------------------------------
@@ -206,7 +234,7 @@ def tree_sum(p: Point, axis: int = 0) -> Point:
 # ---------------------------------------------------------------------------
 
 def decode(wire: jnp.ndarray) -> tuple[Point, jnp.ndarray]:
-    """RFC 9496 DECODE on [..., 32] byte arrays.
+    """RFC 9496 DECODE on [32, ...batch] byte arrays.
 
     Returns (point, valid_mask). Invalid lanes yield the identity point with
     ``valid == False`` — the reference's error returns
@@ -216,8 +244,8 @@ def decode(wire: jnp.ndarray) -> tuple[Point, jnp.ndarray]:
     b = wire.astype(jnp.int32)
     s = limbs.from_bytes_le(b)
     # canonical check: re-encoding must reproduce the input bytes
-    canonical_ok = jnp.all(limbs.to_bytes_le(s) == b, axis=-1)
-    even_ok = (b[..., 0] & 1) == 0
+    canonical_ok = jnp.all(limbs.to_bytes_le(s) == b, axis=0)
+    even_ok = (b[0] & 1) == 0
 
     ss = limbs.square(s)
     u1 = limbs.sub(limbs.ONE, ss)
@@ -238,14 +266,14 @@ def decode(wire: jnp.ndarray) -> tuple[Point, jnp.ndarray]:
         & ~limbs.is_negative(t)
         & ~limbs.is_zero(y)
     )
-    one = jnp.broadcast_to(limbs.ONE, x.shape)
+    one = identity(x.shape[1:])[1]
     zero = jnp.zeros_like(x)
     pt = select(valid, (x, y, one, t), (zero, one, one, zero))
     return pt, valid
 
 
 def encode(p: Point) -> jnp.ndarray:
-    """RFC 9496 ENCODE -> [..., 32] int32 byte values; twin of
+    """RFC 9496 ENCODE -> [32, ...batch] int32 byte values; twin of
     ``core.edwards.ristretto_encode``."""
     X0, Y0, Z0, T0 = p
     u1 = limbs.mul(limbs.add(Z0, Y0), limbs.sub(Z0, Y0))

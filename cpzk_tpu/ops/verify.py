@@ -17,9 +17,13 @@ Two device programs, both free of data-dependent control flow:
   host-built correction row carrying ``(-sum a*s)*G + (-b*sum a*s)*H``, and
   accepts iff the total is the identity coset.
 
-Scalar decomposition (mod l) happens on the host; the device sees only
-public 4-bit windows — verification inputs are public, so vartime gathers
-are fine (docs/security.md).
+All arrays are limb-major ([20, n] coords, [64, n] windows) so the batch
+axis rides the TPU vector lanes.  Scalar decomposition (mod l) happens on
+the host; the device sees only public 4-bit windows — verification inputs
+are public, so vartime selects are fine (docs/security.md).
+
+See :mod:`cpzk_tpu.ops.msm` for the windowed-Pippenger path that replaces
+``combined_kernel``'s per-row table chains at large batch sizes.
 """
 
 from __future__ import annotations
@@ -27,39 +31,25 @@ from __future__ import annotations
 import jax.numpy as jnp
 from jax import lax
 
-from . import curve, limbs
-from .curve import NWINDOWS, Point, TABLE, WINDOW_BITS
-
-
-def build_table(p: Point) -> tuple[jnp.ndarray, ...]:
-    """[0..15] * p, coords stacked on axis -2 -> 4 x [..., 16, 20]."""
-    tbl = [curve.identity(p[0].shape[:-1]), p]
-    for _ in range(TABLE - 2):
-        tbl.append(curve.add(tbl[-1], p))
-    return tuple(jnp.stack([t[i] for t in tbl], axis=-2) for i in range(4))
-
-
-def _gather(table: tuple[jnp.ndarray, ...], idx: jnp.ndarray) -> Point:
-    if table[0].ndim == 2:  # shared (unbatched) table: [16, 20]
-        return tuple(jnp.take(c, idx, axis=0) for c in table)
-    return curve._table_gather(table, idx)
+from . import curve
+from .curve import Point, WINDOW_BITS, build_table, table_gather
 
 
 def _msm_rows(tables: list[tuple[jnp.ndarray, ...]], windows: list[jnp.ndarray]) -> Point:
     """Shared-doubling multi-term scalar-mul.
 
-    ``tables[k]`` is the window table of point set k (coords [..., 16, 20] or
-    broadcastable), ``windows[k]`` its [..., 64] window array (MSB first).
+    ``tables[k]`` is the window table of point set k (coords [16, 20, ...] or
+    broadcastable), ``windows[k]`` its [64, ...] window array (MSB first).
     Returns sum_k scalar_k * point_k per lane: one doubling ladder total.
     """
-    shape = windows[0].shape[:-1]
-    wT = jnp.stack([jnp.moveaxis(w, -1, 0) for w in windows], axis=1)  # [64, K, ...]
+    shape = windows[0].shape[1:]
+    wT = jnp.stack(windows, axis=1)  # [64, K, ...]
 
     def step(acc: Point, w):
         for _ in range(WINDOW_BITS):
             acc = curve.double(acc)
         for k, table in enumerate(tables):
-            acc = curve.add(acc, _gather(table, w[k]))
+            acc = curve.add(acc, table_gather(table, w[k]))
         return acc, None
 
     acc, _ = lax.scan(step, curve.identity(shape), wT)
@@ -78,10 +68,10 @@ def verify_each_kernel(
 ) -> jnp.ndarray:
     """Per-proof checks -> [n] bool.
 
-    ``g``/``h`` are single (unbatched) points; ``y*``/``r*`` are [n]-batched;
-    ``ws``/``wc`` are [n, 64] windows of s and c.
+    ``g``/``h`` are [20, 1] (shared, broadcast) points; ``y*``/``r*`` are
+    [20, n]; ``ws``/``wc`` are [64, n] windows of s and c.
     """
-    tg = build_table(g)     # [16, 20] coords, broadcast-gathered per lane
+    tg = build_table(g)     # [16, 20, 1] coords, broadcast-selected per lane
     th = build_table(h)
     tny1 = build_table(curve.negate(y1))
     tny2 = build_table(curve.negate(y2))
@@ -113,5 +103,5 @@ def combined_kernel(
         [build_table(r1), build_table(y1), build_table(r2), build_table(y2)],
         [w_a, w_ac, w_ba, w_bac],
     )
-    total = curve.tree_sum(rows, axis=0)
+    total = curve.tree_sum(rows, axis=-1)
     return curve.is_identity(total)

@@ -1,19 +1,27 @@
 """Mesh-sharded batch verification (shard_map + ICI collectives).
 
 Design (SURVEY.md §2.3, §5 long-context entry): proofs are embarrassingly
-parallel along the batch axis, so every row array (`[n, ...]` points and
-`[n, 64]` scalar windows) is sharded over a 1-D device mesh. The per-proof
-kernel needs no communication at all; the combined RLC check reduces each
-device's shard to one partial point locally, then combines the ``D`` partial
-points with one tiny cross-device gather — the multi-chip analog of the
-reference's accumulation loop at ``src/verifier/batch.rs:271-312``.
+parallel along the batch axis, so every row array ([20, n] limb-major point
+coords and [64, n] scalar windows — batch rides the minor axis / vector
+lanes) is sharded over a 1-D device mesh along that batch axis.  The
+per-proof kernel needs no communication at all; the combined RLC check
+reduces each device's shard to one partial point locally, then combines the
+``D`` partial points with one tiny cross-device gather — the multi-chip
+analog of the reference's accumulation loop at
+``src/verifier/batch.rs:271-312``.
+
+``pad_to_multiple`` handles ragged batches here (instead of at every call
+site): identity points with zero windows are verified-true rows in the
+per-proof kernel and contribute the identity to the combined sum.
 """
 
 from __future__ import annotations
 
+import numpy as np
+
 import jax
 import jax.numpy as jnp
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, PartitionSpec as P
 from jax.experimental.shard_map import shard_map
 
 from ..ops import curve, verify
@@ -25,23 +33,51 @@ def batch_mesh(devices=None) -> Mesh:
     """1-D data-parallel mesh over all (or the given) devices."""
     if devices is None:
         devices = jax.devices()
-    import numpy as np
-
     return Mesh(np.asarray(devices), (AXIS,))
+
+
+def pad_to_multiple(pt: curve.Point, n_to: int) -> curve.Point:
+    """Pad a [20, n] point SoA with identity rows up to n_to lanes."""
+    n = pt[0].shape[-1]
+    if n == n_to:
+        return pt
+    pad = curve.identity((n_to - n,))
+    return tuple(jnp.concatenate([c, pc], axis=-1) for c, pc in zip(pt, pad))
+
+
+def pad_windows(w: jnp.ndarray, n_to: int) -> jnp.ndarray:
+    """Pad a [64, n] window array with zero-scalar lanes up to n_to."""
+    n = w.shape[-1]
+    if n == n_to:
+        return w
+    return jnp.concatenate(
+        [w, jnp.zeros(w.shape[:-1] + (n_to - n,), dtype=w.dtype)], axis=-1
+    )
 
 
 def _point_specs(spec):
     return (spec, spec, spec, spec)
 
 
+def _row_spec():
+    # [20, n] coords / [64, n] windows: shard the minor (batch) axis
+    return P(None, AXIS)
+
+
 def sharded_verify_each(mesh: Mesh, g, h, y1, y2, r1, r2, ws, wc):
     """Per-proof checks over a batch-sharded mesh -> [n] bool.
 
-    ``g``/``h`` unbatched (replicated); row arrays sharded on axis 0.
-    Batch size must be divisible by the mesh size (pad with identity rows
-    and zero windows; padded rows verify True).
+    ``g``/``h`` [20, 1] (replicated); row arrays sharded on the batch axis.
+    Ragged batches are padded here to a mesh-size multiple (identity rows
+    with zero windows verify True and are sliced off the result).
     """
-    rows = P(AXIS)
+    n = ws.shape[-1]
+    d = mesh.devices.size
+    n_to = -(-n // d) * d
+    y1, y2, r1, r2 = (pad_to_multiple(p, n_to) for p in (y1, y2, r1, r2))
+    ws, wc = pad_windows(ws, n_to), pad_windows(wc, n_to)
+
+    rows = _row_spec()
     rep = P()
     fn = shard_map(
         verify.verify_each_kernel,
@@ -56,10 +92,10 @@ def sharded_verify_each(mesh: Mesh, g, h, y1, y2, r1, r2, ws, wc):
             rows,
             rows,
         ),
-        out_specs=rows,
+        out_specs=P(AXIS),
         check_rep=False,
     )
-    return jax.jit(fn)(g, h, y1, y2, r1, r2, ws, wc)
+    return jax.jit(fn)(g, h, y1, y2, r1, r2, ws, wc)[:n]
 
 
 def _combined_partial(r1, y1, r2, y2, w_a, w_ac, w_ba, w_bac):
@@ -72,8 +108,8 @@ def _combined_partial(r1, y1, r2, y2, w_a, w_ac, w_ba, w_bac):
         ],
         [w_a, w_ac, w_ba, w_bac],
     )
-    partial = curve.tree_sum(rows, axis=0)
-    return tuple(c[None] for c in partial)  # [1, 20] per device
+    partial = curve.tree_sum(rows, axis=-1)
+    return tuple(c[:, None] for c in partial)  # [20, 1] per device
 
 
 def sharded_combined_check(mesh: Mesh, r1, y1, r2, y2, w_a, w_ac, w_ba, w_bac):
@@ -82,9 +118,17 @@ def sharded_combined_check(mesh: Mesh, r1, y1, r2, y2, w_a, w_ac, w_ba, w_bac):
     Each device reduces its shard to one partial point (local tree-sum);
     the ``D`` partials are then combined and tested against the identity.
     The caller has already appended the ``(-sum a s) G + (-b sum a s) H``
-    correction row (see :meth:`cpzk_tpu.ops.backend.TpuBackend.verify_combined`).
+    correction row (see :meth:`cpzk_tpu.ops.backend.TpuBackend.verify_combined`);
+    ragged batches are padded here to a mesh-size multiple (identity rows
+    with zero windows contribute the identity to the sum).
     """
-    rows = P(AXIS)
+    n = w_a.shape[-1]
+    d = mesh.devices.size
+    n_to = -(-n // d) * d
+    r1, y1, r2, y2 = (pad_to_multiple(p, n_to) for p in (r1, y1, r2, y2))
+    w_a, w_ac, w_ba, w_bac = (pad_windows(w, n_to) for w in (w_a, w_ac, w_ba, w_bac))
+
+    rows = _row_spec()
     partial_fn = shard_map(
         _combined_partial,
         mesh=mesh,
@@ -98,13 +142,13 @@ def sharded_combined_check(mesh: Mesh, r1, y1, r2, y2, w_a, w_ac, w_ba, w_bac):
             rows,
             rows,
         ),
-        out_specs=_point_specs(P(AXIS)),
+        out_specs=_point_specs(P(None, AXIS)),
         check_rep=False,
     )
 
     def check(*args):
-        partials = partial_fn(*args)  # [D, 20] coords, one row per device
-        total = curve.tree_sum(partials, axis=0)
+        partials = partial_fn(*args)  # [20, D] coords, one lane per device
+        total = curve.tree_sum(partials, axis=-1)
         return curve.is_identity(total)
 
     return jax.jit(check)(r1, y1, r2, y2, w_a, w_ac, w_ba, w_bac)

@@ -1,19 +1,35 @@
 """Test harness config.
 
-JAX-based tests run on a virtual 8-device CPU mesh so multi-chip sharding
-logic is exercised without TPU hardware (SURVEY.md §4 multi-node story).
-Env vars must be set before the first ``import jax`` anywhere in the test
-process.
+JAX-based tests run on the CPU backend with a virtual 8-device topology so
+multi-chip sharding logic is exercised without TPU hardware (SURVEY.md §4
+multi-node story).
+
+The axon TPU plugin's sitecustomize imports jax at interpreter startup with
+``JAX_PLATFORMS=axon`` already in the environment, so mutating ``os.environ``
+here is too late for jax's config cache — ``jax.config.update`` is the only
+reliable override.  ``XLA_FLAGS`` is still read at CPU-client creation time,
+which happens after this module runs, so the env var works for the device
+count.
 """
 
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-# the axon TPU plugin ignores JAX_PLATFORMS; JAX_PLATFORM_NAME still wins
-os.environ.setdefault("JAX_PLATFORM_NAME", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["JAX_PLATFORM_NAME"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+# persistent XLA compilation cache: the crypto kernels are compile-heavy and
+# shape-stable, so warm runs of the device test tier drop from minutes to
+# seconds.  Safe to share across processes; keyed by HLO + compile options.
+_cache_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache")
+jax.config.update("jax_compilation_cache_dir", _cache_dir)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -75,20 +75,20 @@ def test_tree_sum(pts):
     acc = he.IDENTITY
     for a in p:
         acc = he.pt_add(acc, a)
-    assert_points_equal([acc], tuple(c[None] for c in j_tree_sum(dp)))
+    assert_points_equal([acc], tuple(c[:, None] for c in j_tree_sum(dp)))
 
     # non-power-of-two length
     p3 = p[:3]
-    dp3 = tuple(c[:3] for c in dp)
+    dp3 = tuple(c[:, :3] for c in dp)
     acc3 = he.pt_add(he.pt_add(p3[0], p3[1]), p3[2])
-    assert_points_equal([acc3], tuple(c[None] for c in j_tree_sum(dp3)))
+    assert_points_equal([acc3], tuple(c[:, None] for c in j_tree_sum(dp3)))
 
 
 def test_encode_decode_roundtrip(pts):
     p, _, dp, _ = pts
     wire_host = [he.ristretto_encode(a) for a in p]
-    enc = np.asarray(j_encode(dp)).astype(np.uint8)
-    assert [bytes(r.tobytes()) for r in enc] == wire_host
+    enc = np.asarray(j_encode(dp)).astype(np.uint8)  # [32, n]
+    assert [bytes(enc[:, j].tobytes()) for j in range(N)] == wire_host
 
     dec, valid = j_decode(jax.numpy.asarray(enc))
     assert list(np.asarray(valid)) == [True] * N
@@ -108,6 +108,6 @@ def test_decode_rejects_invalid():
     # not on curve: s=2 -> check host
     cases.append((2).to_bytes(32, "little"))
     arr = np.frombuffer(b"".join(cases), dtype=np.uint8).reshape(len(cases), 32)
-    _, valid = j_decode(jax.numpy.asarray(arr))
+    _, valid = j_decode(jax.numpy.asarray(arr.T))
     expected = [he.ristretto_decode(c) is not None for c in cases]
     assert list(np.asarray(valid)) == expected

@@ -60,7 +60,7 @@ def test_mul_small(ab):
 
 def test_canonical_idempotent_on_large_values():
     vals = [hf.P, hf.P + 1, 2 * hf.P + 5, (1 << 260) - 1, hf.P - 1]
-    la = np.stack([limbs.int_to_limbs(v) for v in vals])
+    la = np.stack([limbs.int_to_limbs(v) for v in vals], axis=-1)
     check([v % hf.P for v in vals], limbs.canonical(la))
 
 
@@ -109,26 +109,32 @@ def test_loose_limb_bounds_adversarial():
         np.asarray([BOUND if i % 2 else -BOUND for i in range(limbs.NLIMBS)], dtype=np.int32),
         np.asarray([-BOUND] + [BOUND] * (limbs.NLIMBS - 1), dtype=np.int32),
     ]
-    la = np.stack(patterns)
+    la = np.stack(patterns, axis=-1)  # [20, 4] limb-major
     vals = [limbs.limbs_to_int(p) for p in patterns]
     for out, expect in (
         (limbs.mul(la, la), [v * v for v in vals]),
-        (limbs.mul(la, la[::-1].copy()), [v * w for v, w in zip(vals, vals[::-1])]),
+        (limbs.mul(la, la[:, ::-1].copy()), [v * w for v, w in zip(vals, vals[::-1])]),
         (limbs.add(la, la), [2 * v for v in vals]),
-        (limbs.sub(la, la[::-1].copy()), [v - w for v, w in zip(vals, vals[::-1])]),
-        (limbs.square(limbs.add(la, la[::-1].copy())), [(v + w) ** 2 for v, w in zip(vals, vals[::-1])]),
+        (limbs.sub(la, la[:, ::-1].copy()), [v - w for v, w in zip(vals, vals[::-1])]),
+        (limbs.square(limbs.add(la, la[:, ::-1].copy())), [(v + w) ** 2 for v, w in zip(vals, vals[::-1])]),
     ):
         check([e % hf.P for e in expect], out)
 
     # loose outputs stay mul-safe: |limb| <= BOUND after every op
-    for op_out in (limbs.mul(la, la), limbs.add(la, la), limbs.sub(la, la[::-1].copy())):
+    for op_out in (limbs.mul(la, la), limbs.add(la, la), limbs.sub(la, la[:, ::-1].copy())):
         assert int(np.abs(np.asarray(op_out)).max()) <= BOUND
 
 
 def test_bytes_roundtrip(ab):
     a, _, la, _ = ab
-    enc = np.asarray(limbs.to_bytes_le(la))
+    enc = np.asarray(limbs.to_bytes_le(la))  # [32, n]
     expected = [hf.fe_to_bytes(x) for x in a]
-    assert [bytes(row.astype(np.uint8).tobytes()) for row in enc] == expected
+    assert [bytes(enc[:, j].astype(np.uint8).tobytes()) for j in range(N)] == expected
     back = limbs.from_bytes_le(enc)
     check(a, back)
+
+
+def test_bytes_to_limbs_vectorized(ab):
+    a, _, _, _ = ab
+    rows = np.stack([np.frombuffer(hf.fe_to_bytes(x), dtype=np.uint8) for x in a])
+    check(a, limbs.bytes_to_limbs(rows))
