@@ -75,12 +75,35 @@ def _msm_identity(c, points, digits):
 
 
 class TpuBackend(VerifierBackend):
-    """Vectorized device backend (TPU when available, any JAX backend)."""
+    """Vectorized device backend (TPU when available, any JAX backend).
+
+    ``mesh_devices``: ``None`` pins single-device execution; ``0`` shards
+    the batch axis over all visible devices (production default via the
+    ``tpu.mesh_devices`` config knob); ``k > 1`` uses the first k.  The
+    sharded paths ride ICI collectives via ``shard_map``
+    (:mod:`cpzk_tpu.parallel.mesh`).
+    """
 
     prefers_combined = True
 
-    def __init__(self):
+    def __init__(self, mesh_devices: int | None = None):
         self._gh_cache: dict[tuple[bytes, bytes], tuple[curve.Point, curve.Point]] = {}
+        self._mesh = None
+        self._sharded_each = None
+        self._sharded_msm = None
+        if mesh_devices is not None:
+            n_avail = jax.device_count()
+            want = n_avail if mesh_devices == 0 else min(mesh_devices, n_avail)
+            if want > 1:
+                from ..parallel import (
+                    batch_mesh,
+                    make_sharded_msm_check,
+                    make_sharded_verify_each,
+                )
+
+                self._mesh = batch_mesh(jax.devices()[:want])
+                self._sharded_each = make_sharded_verify_each(self._mesh)
+                self._sharded_msm = make_sharded_msm_check(self._mesh)
 
     def _gh(self, row: BatchRow) -> tuple[curve.Point, curve.Point]:
         key = (
@@ -153,6 +176,8 @@ class TpuBackend(VerifierBackend):
         digits = jnp.asarray(
             msm.scalars_to_signed_digits(scalars + [0] * (m - len(scalars)), c)
         )
+        if self._sharded_msm is not None:
+            return bool(self._sharded_msm(pts, digits, c))
         return bool(_msm_identity(c, pts, digits))
 
     def verify_each(self, rows: list[BatchRow]) -> list[bool]:
@@ -171,5 +196,8 @@ class TpuBackend(VerifierBackend):
         ws = _windows([r.s.value for r in rows], pad)
         wc = _windows([r.c.value for r in rows], pad)
 
-        mask = _each_shared(pad, g, h, y1, y2, r1, r2, ws, wc)
+        if self._sharded_each is not None and shared:
+            mask = self._sharded_each(g, h, y1, y2, r1, r2, ws, wc)
+        else:
+            mask = _each_shared(pad, g, h, y1, y2, r1, r2, ws, wc)
         return [bool(v) for v in np.asarray(mask)[:n]]

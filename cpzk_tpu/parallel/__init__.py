@@ -7,6 +7,22 @@ and the combined-check reduction rides ICI collectives (``psum`` under
 ``shard_map``), never DCN, matching the scaling-book recipe.
 """
 
-from .mesh import batch_mesh, sharded_combined_check, sharded_verify_each
+from .mesh import (
+    batch_mesh,
+    make_sharded_combined_check,
+    make_sharded_msm_check,
+    make_sharded_verify_each,
+    sharded_combined_check,
+    sharded_msm_check,
+    sharded_verify_each,
+)
 
-__all__ = ["batch_mesh", "sharded_combined_check", "sharded_verify_each"]
+__all__ = [
+    "batch_mesh",
+    "make_sharded_combined_check",
+    "make_sharded_msm_check",
+    "make_sharded_verify_each",
+    "sharded_combined_check",
+    "sharded_msm_check",
+    "sharded_verify_each",
+]

@@ -24,7 +24,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 from jax.experimental.shard_map import shard_map
 
-from ..ops import curve, verify
+from ..ops import curve, msm, verify
 
 AXIS = "batch"
 
@@ -64,38 +64,49 @@ def _row_spec():
     return P(None, AXIS)
 
 
-def sharded_verify_each(mesh: Mesh, g, h, y1, y2, r1, r2, ws, wc):
-    """Per-proof checks over a batch-sharded mesh -> [n] bool.
+def make_sharded_verify_each(mesh: Mesh):
+    """Reusable (jit-cached) sharded per-proof checker for ``mesh``.
 
-    ``g``/``h`` [20, 1] (replicated); row arrays sharded on the batch axis.
-    Ragged batches are padded here to a mesh-size multiple (identity rows
-    with zero windows verify True and are sliced off the result).
+    Returns ``call(g, h, y1, y2, r1, r2, ws, wc) -> [n] bool``; ``g``/``h``
+    [20, 1] (replicated), row arrays sharded on the batch axis.  Ragged
+    batches are padded to a mesh-size multiple (identity rows with zero
+    windows verify True and are sliced off the result).
     """
-    n = ws.shape[-1]
-    d = mesh.devices.size
-    n_to = -(-n // d) * d
-    y1, y2, r1, r2 = (pad_to_multiple(p, n_to) for p in (y1, y2, r1, r2))
-    ws, wc = pad_windows(ws, n_to), pad_windows(wc, n_to)
-
     rows = _row_spec()
     rep = P()
-    fn = shard_map(
-        verify.verify_each_kernel,
-        mesh=mesh,
-        in_specs=(
-            _point_specs(rep),
-            _point_specs(rep),
-            _point_specs(rows),
-            _point_specs(rows),
-            _point_specs(rows),
-            _point_specs(rows),
-            rows,
-            rows,
-        ),
-        out_specs=P(AXIS),
-        check_rep=False,
+    fn = jax.jit(
+        shard_map(
+            verify.verify_each_kernel,
+            mesh=mesh,
+            in_specs=(
+                _point_specs(rep),
+                _point_specs(rep),
+                _point_specs(rows),
+                _point_specs(rows),
+                _point_specs(rows),
+                _point_specs(rows),
+                rows,
+                rows,
+            ),
+            out_specs=P(AXIS),
+            check_rep=False,
+        )
     )
-    return jax.jit(fn)(g, h, y1, y2, r1, r2, ws, wc)[:n]
+    d = mesh.devices.size
+
+    def call(g, h, y1, y2, r1, r2, ws, wc):
+        n = ws.shape[-1]
+        n_to = -(-n // d) * d
+        y1, y2, r1, r2 = (pad_to_multiple(p, n_to) for p in (y1, y2, r1, r2))
+        ws, wc = pad_windows(ws, n_to), pad_windows(wc, n_to)
+        return fn(g, h, y1, y2, r1, r2, ws, wc)[:n]
+
+    return call
+
+
+def sharded_verify_each(mesh: Mesh, g, h, y1, y2, r1, r2, ws, wc):
+    """One-shot convenience wrapper over :func:`make_sharded_verify_each`."""
+    return make_sharded_verify_each(mesh)(g, h, y1, y2, r1, r2, ws, wc)
 
 
 def _combined_partial(r1, y1, r2, y2, w_a, w_ac, w_ba, w_bac):
@@ -112,22 +123,16 @@ def _combined_partial(r1, y1, r2, y2, w_a, w_ac, w_ba, w_bac):
     return tuple(c[:, None] for c in partial)  # [20, 1] per device
 
 
-def sharded_combined_check(mesh: Mesh, r1, y1, r2, y2, w_a, w_ac, w_ba, w_bac):
-    """Combined RLC check over a batch-sharded mesh -> scalar bool.
+def make_sharded_combined_check(mesh: Mesh):
+    """Reusable (jit-cached) sharded combined-RLC checker for ``mesh``.
 
     Each device reduces its shard to one partial point (local tree-sum);
     the ``D`` partials are then combined and tested against the identity.
     The caller has already appended the ``(-sum a s) G + (-b sum a s) H``
     correction row (see :meth:`cpzk_tpu.ops.backend.TpuBackend.verify_combined`);
-    ragged batches are padded here to a mesh-size multiple (identity rows
-    with zero windows contribute the identity to the sum).
+    ragged batches are padded to a mesh-size multiple (identity rows with
+    zero windows contribute the identity to the sum).
     """
-    n = w_a.shape[-1]
-    d = mesh.devices.size
-    n_to = -(-n // d) * d
-    r1, y1, r2, y2 = (pad_to_multiple(p, n_to) for p in (r1, y1, r2, y2))
-    w_a, w_ac, w_ba, w_bac = (pad_windows(w, n_to) for w in (w_a, w_ac, w_ba, w_bac))
-
     rows = _row_spec()
     partial_fn = shard_map(
         _combined_partial,
@@ -151,4 +156,73 @@ def sharded_combined_check(mesh: Mesh, r1, y1, r2, y2, w_a, w_ac, w_ba, w_bac):
         total = curve.tree_sum(partials, axis=-1)
         return curve.is_identity(total)
 
-    return jax.jit(check)(r1, y1, r2, y2, w_a, w_ac, w_ba, w_bac)
+    jcheck = jax.jit(check)
+    d = mesh.devices.size
+
+    def call(r1, y1, r2, y2, w_a, w_ac, w_ba, w_bac):
+        n = w_a.shape[-1]
+        n_to = -(-n // d) * d
+        r1, y1, r2, y2 = (pad_to_multiple(p, n_to) for p in (r1, y1, r2, y2))
+        w_a, w_ac, w_ba, w_bac = (
+            pad_windows(w, n_to) for w in (w_a, w_ac, w_ba, w_bac)
+        )
+        return jcheck(r1, y1, r2, y2, w_a, w_ac, w_ba, w_bac)
+
+    return call
+
+
+def sharded_combined_check(mesh: Mesh, r1, y1, r2, y2, w_a, w_ac, w_ba, w_bac):
+    """One-shot convenience wrapper over :func:`make_sharded_combined_check`."""
+    return make_sharded_combined_check(mesh)(r1, y1, r2, y2, w_a, w_ac, w_ba, w_bac)
+
+
+def make_sharded_msm_check(mesh: Mesh):
+    """Reusable sharded Pippenger-MSM == identity checker for ``mesh``.
+
+    An MSM is a sum over (point, scalar) terms, so lane-sharding is exact:
+    each device runs the full windowed-Pippenger kernel on its shard of the
+    terms ([20, m/D] coords + [K, m/D] digits), producing one partial point;
+    the ``D`` partials combine with one tiny cross-device gather — the ICI
+    traffic is 4 coords x 20 limbs per device per batch, nothing else.
+
+    Returns ``call(points, digits, c) -> scalar bool`` (``c`` static per
+    compiled variant, cached by window size).
+    """
+    rows = _row_spec()
+    d = mesh.devices.size
+    cache: dict[int, object] = {}
+
+    def build(c: int):
+        def partial(points, digits):
+            return msm.msm_kernel(points, digits, c)  # [20, 1] per device
+
+        fn = shard_map(
+            partial,
+            mesh=mesh,
+            in_specs=(_point_specs(rows), rows),
+            out_specs=_point_specs(P(None, AXIS)),
+            check_rep=False,
+        )
+
+        def check(points, digits):
+            partials = fn(points, digits)  # [20, D]
+            total = curve.tree_sum(partials, axis=-1)
+            return curve.is_identity(total)
+
+        return jax.jit(check)
+
+    def call(points, digits, c: int):
+        m = digits.shape[-1]
+        m_to = -(-m // d) * d
+        points = pad_to_multiple(points, m_to)
+        digits = pad_windows(digits, m_to)
+        if c not in cache:
+            cache[c] = build(c)
+        return cache[c](points, digits)
+
+    return call
+
+
+def sharded_msm_check(mesh: Mesh, points, digits, c: int):
+    """One-shot convenience wrapper over :func:`make_sharded_msm_check`."""
+    return make_sharded_msm_check(mesh)(points, digits, c)

@@ -127,6 +127,65 @@ class CpuBackend(VerifierBackend):
         return out
 
 
+class FailoverBackend(VerifierBackend):
+    """TPU→CPU failover wrapper (SURVEY.md §5 failure detection).
+
+    Routes to ``primary`` until it raises, then degrades permanently (for
+    this instance) to ``fallback`` — a failed combined check simply reports
+    False so the dispatcher's per-proof path decides, keeping accept/reject
+    semantics byte-identical through a mid-batch backend loss.  The
+    ``tpu.backend.failover`` counter records degradations; ``reset()``
+    re-arms the primary (e.g. after an operator fixed the device).
+    """
+
+    def __init__(self, primary: VerifierBackend, fallback: VerifierBackend):
+        self.primary = primary
+        self.fallback = fallback
+        self.degraded = False
+
+    @property
+    def prefers_combined(self) -> bool:  # type: ignore[override]
+        backend = self.fallback if self.degraded else self.primary
+        return backend.prefers_combined
+
+    def reset(self) -> None:
+        self.degraded = False
+
+    def _note_failure(self, exc: Exception) -> None:
+        import logging
+
+        logging.getLogger("cpzk_tpu.protocol.batch").exception(
+            "primary verifier backend failed; degrading to fallback: %s", exc
+        )
+        self.degraded = True
+        try:  # metrics live in the server layer; optional here
+            from ..server import metrics
+
+            metrics.counter("tpu.backend.failover").inc()
+        except Exception:
+            pass
+
+    def verify_combined(self, rows: list[BatchRow], beta: Scalar) -> bool:
+        if not self.degraded:
+            try:
+                return self.primary.verify_combined(rows, beta)
+            except Exception as exc:
+                self._note_failure(exc)
+        # a False combined check routes the dispatcher to verify_each,
+        # which is the ground-truth path on the fallback backend
+        if self.fallback.prefers_combined:
+            return self.fallback.verify_combined(rows, beta)
+        return False
+
+    def verify_each(self, rows: list[BatchRow]) -> list[bool]:
+        if not self.degraded:
+            try:
+                return self.primary.verify_each(rows)
+            except Exception as exc:
+                self._note_failure(exc)
+        return self.fallback.verify_each(rows)
+
+
 _DEFAULT_BACKEND: VerifierBackend | None = None
 
 
@@ -144,10 +203,22 @@ def set_default_backend(backend: VerifierBackend | None) -> None:
 
 
 class BatchVerifier:
-    """Accumulate-and-verify batch API (reference ``BatchVerifier`` twin)."""
+    """Accumulate-and-verify batch API (reference ``BatchVerifier`` twin).
 
-    def __init__(self, backend: VerifierBackend | None = None):
+    ``max_size`` defaults to the reference's 1000-entry cap (parity for the
+    gRPC per-request surface) but is configurable up to device scale — the
+    TPU backend amortizes best at 64k+ rows (SURVEY.md §7.5), where the
+    reference's O(n) host loop had no reason to go."""
+
+    def __init__(
+        self,
+        backend: VerifierBackend | None = None,
+        max_size: int = MAX_BATCH_SIZE,
+    ):
+        if max_size < 1:
+            raise InvalidParams("Batch capacity must be positive")
         self.entries: list[BatchEntry] = []
+        self.max_size = max_size
         self._backend = backend
 
     @staticmethod
@@ -165,7 +236,7 @@ class BatchVerifier:
         return not self.entries
 
     def remaining_capacity(self) -> int:
-        return max(0, MAX_BATCH_SIZE - len(self.entries))
+        return max(0, self.max_size - len(self.entries))
 
     def clear(self) -> None:
         """Empty the batch for reuse (reference BatchVerifier::clear)."""
@@ -182,8 +253,8 @@ class BatchVerifier:
         context: bytes | None,
     ) -> None:
         """Validates the statement on add (batch.rs:139-168)."""
-        if len(self.entries) >= MAX_BATCH_SIZE:
-            raise InvalidParams(f"Batch size limit exceeded (max {MAX_BATCH_SIZE})")
+        if len(self.entries) >= self.max_size:
+            raise InvalidParams(f"Batch size limit exceeded (max {self.max_size})")
         statement.validate()
         self.entries.append(BatchEntry(params, statement, proof, context))
 

@@ -48,8 +48,39 @@ def parse_args(argv=None) -> argparse.Namespace:
                    default=int(os.environ.get("SERVER_RATE_LIMIT", "100")))
     p.add_argument("--rate-burst", type=int,
                    default=int(os.environ.get("SERVER_RATE_BURST", "10")))
+    p.add_argument("--backend", choices=("cpu", "tpu"),
+                   default=os.environ.get("SERVER_TPU_BACKEND", None),
+                   help="verifier backend: cpu (inline host verify) or tpu "
+                        "(JAX data plane + dynamic batching + CPU failover)")
+    p.add_argument("--batch-max", type=int, default=None,
+                   help="dynamic-batcher device batch target (tpu backend)")
+    p.add_argument("--batch-window-ms", type=float, default=None,
+                   help="dynamic-batcher queue deadline in ms (tpu backend)")
     p.add_argument("--no-repl", action="store_true", help="run headless (no admin REPL)")
     return p.parse_args(argv)
+
+
+def build_backend(config):
+    """(backend, batcher) for the resolved config: the TPU data plane behind
+    a CPU failover and a dynamic batching queue, or (None, None) for the
+    reference-parity inline CPU path."""
+    if config.tpu.backend != "tpu":
+        return None, None
+    from ..ops.backend import TpuBackend
+    from ..protocol.batch import CpuBackend, FailoverBackend
+    from .batching import DynamicBatcher
+
+    # mesh_devices semantics: 0 = shard over all visible devices (default),
+    # k = first k devices; TpuBackend skips the mesh when only 1 is visible
+    backend = FailoverBackend(
+        TpuBackend(mesh_devices=config.tpu.mesh_devices), CpuBackend()
+    )
+    batcher = DynamicBatcher(
+        backend,
+        max_batch=config.tpu.batch_max,
+        window_ms=config.tpu.batch_window_ms,
+    )
+    return backend, batcher
 
 
 async def cleanup_supervisor(state: ServerState, stop: asyncio.Event) -> None:
@@ -139,6 +170,12 @@ async def amain(args) -> None:
     config.rate_limit.burst = args.rate_burst
     config.metrics.enabled = args.metrics
     config.metrics.port = args.metrics_port
+    if args.backend is not None:
+        config.tpu.backend = args.backend
+    if args.batch_max is not None:
+        config.tpu.batch_max = args.batch_max
+    if args.batch_window_ms is not None:
+        config.tpu.batch_window_ms = args.batch_window_ms
     config.validate()
 
     state = ServerState()
@@ -163,8 +200,16 @@ async def amain(args) -> None:
 
     from .service import serve
 
+    backend, batcher = build_backend(config)
+    if backend is not None:
+        log.info(
+            "TPU backend enabled (batch_max=%d window=%.1fms, CPU failover armed)",
+            config.tpu.batch_max, config.tpu.batch_window_ms,
+        )
+
     server, port = await serve(
-        state, limiter, host=config.host, port=config.port, tls=tls
+        state, limiter, host=config.host, port=config.port,
+        backend=backend, batcher=batcher, tls=tls,
     )
     print(_c("green", f"AuthService listening on {config.host}:{port}"))
 
@@ -197,6 +242,8 @@ async def amain(args) -> None:
     print(_c("yellow", "shutdown: flipping health to NOT_SERVING, draining..."))
     server.health.serving = False
     await asyncio.sleep(DRAIN_SECONDS)
+    if batcher is not None:
+        await batcher.stop()  # drain queued verifications before the listener
     await server.stop(grace=5)
     cleanup_task.cancel()
     if repl_task is not None:

@@ -46,10 +46,12 @@ class AuthServiceImpl:
         state: ServerState,
         rate_limiter: RateLimiter,
         backend: VerifierBackend | None = None,
+        batcher=None,
     ):
         self.state = state
         self.rate_limiter = rate_limiter
         self.backend = backend
+        self.batcher = batcher  # DynamicBatcher | None (TPU serving path)
         self.pb2 = load_pb2()
         self.rng = SecureRng()
 
@@ -246,16 +248,26 @@ class AuthServiceImpl:
             metrics.counter("auth.verify.failure").inc()
             await context.abort(grpc.StatusCode.INVALID_ARGUMENT, f"Invalid proof: {e}")
 
-        verifier = Verifier(Parameters.new(), user.statement)
-        transcript = Transcript()
-        transcript.append_context(request.challenge_id)
-        try:
-            verifier.verify_with_transcript(proof, transcript)
-        except errors.Error as e:
+        if self.batcher is not None:
+            # TPU serving path: coalesce with concurrent RPCs into one
+            # device batch; per-entry result has identical semantics
+            verify_err = await self.batcher.submit(
+                Parameters.new(), user.statement, proof, bytes(request.challenge_id)
+            )
+        else:
+            verifier = Verifier(Parameters.new(), user.statement)
+            transcript = Transcript()
+            transcript.append_context(request.challenge_id)
+            try:
+                verifier.verify_with_transcript(proof, transcript)
+                verify_err = None
+            except errors.Error as e:
+                verify_err = e
+        if verify_err is not None:
             metrics.counter("auth.verify.failure").inc()
             metrics.histogram("auth.verify.duration").observe(time.perf_counter() - start)
             await context.abort(
-                grpc.StatusCode.PERMISSION_DENIED, f"Verification failed: {e}"
+                grpc.StatusCode.PERMISSION_DENIED, f"Verification failed: {verify_err}"
             )
 
         token = self.rng.fill_bytes(32).hex()
@@ -346,7 +358,21 @@ class AuthServiceImpl:
         batch_results: list = []
         if len(batch) > 0:
             try:
-                batch_results = batch.verify(self.rng)
+                if self.batcher is not None:
+                    import asyncio
+
+                    batch_results = list(
+                        await asyncio.gather(
+                            *[
+                                self.batcher.submit(
+                                    e.params, e.statement, e.proof, e.transcript_context
+                                )
+                                for e in batch.entries
+                            ]
+                        )
+                    )
+                else:
+                    batch_results = batch.verify(self.rng)
             except errors.Error as e:
                 metrics.counter("auth.verify_batch.failure").inc()
                 await context.abort(grpc.StatusCode.INTERNAL, f"Batch verification failed: {e}")
@@ -445,19 +471,26 @@ async def serve(
     host: str = "127.0.0.1",
     port: int = 50051,
     backend: VerifierBackend | None = None,
+    batcher=None,
     tls: tuple[bytes, bytes] | None = None,
 ):
     """Build and start an aio server; returns (server, bound_port).
 
     ``tls`` is an optional (private_key_pem, cert_chain_pem) pair — wired
     for real, unlike the reference where validated TLS settings never reach
-    the transport (SURVEY.md §3.3).
+    the transport (SURVEY.md §3.3).  ``batcher`` is an optional started-here
+    :class:`~cpzk_tpu.server.batching.DynamicBatcher` routing verification
+    through the TPU data plane; it is exposed as ``server.batcher`` so the
+    daemon can drain it on shutdown.
     """
     server = grpc.aio.server()
-    service = AuthServiceImpl(state, rate_limiter, backend=backend)
+    service = AuthServiceImpl(state, rate_limiter, backend=backend, batcher=batcher)
     server.add_generic_rpc_handlers((make_generic_handler(service),))
     health = _add_health_service(server)
     server.health = health  # for shutdown: server.health.serving = False
+    server.batcher = batcher
+    if batcher is not None:
+        batcher.start()
     addr = f"{host}:{port}"
     if tls is not None:
         creds = grpc.ssl_server_credentials([tls])

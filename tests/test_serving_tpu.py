@@ -1,0 +1,303 @@
+"""TPU serving-plane tests: dynamic batching queue, TPU→CPU failover, and
+the gRPC integration of both (VERDICT r1 item 3; reference
+``src/verifier/service.rs:407-617`` + BASELINE config 5).
+"""
+
+import asyncio
+
+import pytest
+
+from cpzk_tpu import Parameters, Prover, SecureRng, Transcript, Witness
+from cpzk_tpu.client import AuthClient
+from cpzk_tpu.core.ristretto import Ristretto255
+from cpzk_tpu.protocol.batch import (
+    BatchRow,
+    BatchVerifier,
+    CpuBackend,
+    FailoverBackend,
+    VerifierBackend,
+)
+from cpzk_tpu.server import RateLimiter, ServerState
+from cpzk_tpu.server.batching import DynamicBatcher
+from cpzk_tpu.server.service import serve
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def make_proofs(n, params=None, rng=None):
+    rng = rng or SecureRng()
+    params = params or Parameters.new()
+    out = []
+    for _ in range(n):
+        prover = Prover(params, Witness(Ristretto255.random_scalar(rng)))
+        proof = prover.prove_with_transcript(rng, Transcript())
+        out.append((prover.statement, proof))
+    return params, out
+
+
+class RecordingBatcher(DynamicBatcher):
+    def __init__(self, *a, **kw):
+        super().__init__(*a, **kw)
+        self.dispatched_sizes = []
+
+    async def _dispatch(self, take):
+        self.dispatched_sizes.append(len(take))
+        await super()._dispatch(take)
+
+
+class BrokenBackend(VerifierBackend):
+    """Fault injection: always blows up (simulated device loss)."""
+
+    prefers_combined = True
+
+    def __init__(self):
+        self.calls = 0
+
+    def verify_combined(self, rows, beta):
+        self.calls += 1
+        raise RuntimeError("injected TPU failure")
+
+    def verify_each(self, rows):
+        self.calls += 1
+        raise RuntimeError("injected TPU failure")
+
+
+# --- DynamicBatcher unit behavior ------------------------------------------
+
+
+def test_batcher_coalesces_concurrent_submissions():
+    params, proofs = make_proofs(6)
+
+    async def main():
+        batcher = RecordingBatcher(CpuBackend(), max_batch=64, window_ms=20.0)
+        batcher.start()
+        results = await asyncio.gather(
+            *[batcher.submit(params, st, pr, None) for st, pr in proofs]
+        )
+        await batcher.stop()
+        return batcher, results
+
+    batcher, results = run(main())
+    assert results == [None] * 6
+    # all six landed in one device batch (20ms window >> submission skew)
+    assert batcher.dispatched_sizes == [6]
+
+
+def test_batcher_flags_invalid_entry_per_index():
+    params, proofs = make_proofs(3)
+
+    async def main():
+        batcher = DynamicBatcher(CpuBackend(), max_batch=64, window_ms=5.0)
+        batcher.start()
+        coros = [batcher.submit(params, st, pr, None) for st, pr in proofs]
+        # statement/proof mismatch -> must fail at its index only
+        coros.append(batcher.submit(params, proofs[0][0], proofs[1][1], None))
+        results = await asyncio.gather(*coros)
+        await batcher.stop()
+        return results
+
+    results = run(main())
+    assert [r is None for r in results] == [True, True, True, False]
+
+
+def test_batcher_respects_max_batch():
+    params, proofs = make_proofs(5)
+
+    async def main():
+        batcher = RecordingBatcher(CpuBackend(), max_batch=2, window_ms=5.0)
+        batcher.start()
+        results = await asyncio.gather(
+            *[batcher.submit(params, st, pr, None) for st, pr in proofs]
+        )
+        await batcher.stop()
+        return batcher, results
+
+    batcher, results = run(main())
+    assert results == [None] * 5
+    assert all(s <= 2 for s in batcher.dispatched_sizes)
+    assert sum(batcher.dispatched_sizes) == 5
+
+
+def test_batcher_drains_on_stop():
+    params, proofs = make_proofs(2)
+
+    async def main():
+        batcher = DynamicBatcher(CpuBackend(), max_batch=64, window_ms=5000.0)
+        batcher.start()
+        coros = [
+            asyncio.ensure_future(batcher.submit(params, st, pr, None))
+            for st, pr in proofs
+        ]
+        await asyncio.sleep(0)  # let submissions enqueue
+        await batcher.stop()  # must not wait the 5s window
+        return await asyncio.gather(*coros)
+
+    assert run(main()) == [None, None]
+
+
+# --- failover ---------------------------------------------------------------
+
+
+def test_failover_backend_degrades_to_cpu():
+    params, proofs = make_proofs(4)
+    broken = BrokenBackend()
+    backend = FailoverBackend(broken, CpuBackend())
+    rng = SecureRng()
+
+    bv = BatchVerifier(backend=backend)
+    for st, pr in proofs:
+        bv.add(params, st, pr)
+    assert bv.verify(rng) == [None] * 4
+    assert backend.degraded
+    assert broken.calls == 1  # first failure degrades permanently
+
+    # subsequent batches never touch the broken primary again
+    bv2 = BatchVerifier(backend=backend)
+    for st, pr in proofs:
+        bv2.add(params, st, pr)
+    assert bv2.verify(rng) == [None] * 4
+    assert broken.calls == 1
+
+    backend.reset()
+    assert not backend.degraded
+
+
+def test_failover_mid_each_path():
+    """Primary dies in verify_each (combined already skipped): fallback
+    still returns per-proof ground truth."""
+
+    class EachOnlyBroken(BrokenBackend):
+        prefers_combined = False
+
+    params, proofs = make_proofs(2)
+    backend = FailoverBackend(EachOnlyBroken(), CpuBackend())
+    bv = BatchVerifier(backend=backend)
+    bv.add(params, proofs[0][0], proofs[0][1])
+    bv.add(params, proofs[0][0], proofs[1][1])  # mismatched -> invalid
+    res = bv.verify(SecureRng())
+    assert res[0] is None and res[1] is not None
+    assert backend.degraded
+
+
+def test_failover_through_batcher():
+    params, proofs = make_proofs(3)
+    backend = FailoverBackend(BrokenBackend(), CpuBackend())
+
+    async def main():
+        batcher = DynamicBatcher(backend, max_batch=64, window_ms=5.0)
+        batcher.start()
+        results = await asyncio.gather(
+            *[batcher.submit(params, st, pr, None) for st, pr in proofs]
+        )
+        await batcher.stop()
+        return results
+
+    assert run(main()) == [None] * 3
+    assert backend.degraded
+
+
+# --- gRPC integration -------------------------------------------------------
+
+
+async def _register_and_prove(client, user, rng, params):
+    prover = Prover(params, Witness(Ristretto255.random_scalar(rng)))
+    st = prover.statement
+    resp = await client.register(
+        user,
+        Ristretto255.element_to_bytes(st.y1),
+        Ristretto255.element_to_bytes(st.y2),
+    )
+    assert resp.success
+    ch = await client.create_challenge(user)
+    t = Transcript()
+    t.append_context(bytes(ch.challenge_id))
+    proof = prover.prove_with_transcript(rng, t)
+    return bytes(ch.challenge_id), proof.to_bytes()
+
+
+def test_grpc_serving_through_batcher():
+    """Concurrent VerifyProof RPCs coalesce into device batches and still
+    issue sessions; VerifyProofBatch routes through the same queue."""
+
+    async def main():
+        rng = SecureRng()
+        params = Parameters.new()
+        state = ServerState()
+        batcher = RecordingBatcher(CpuBackend(), max_batch=64, window_ms=25.0)
+        server, port = await serve(
+            state, RateLimiter(10_000, 10_000),
+            host="127.0.0.1", port=0, batcher=batcher,
+        )
+        try:
+            async with AuthClient(f"127.0.0.1:{port}") as client:
+                users = [f"user{i}" for i in range(5)]
+                pairs = [
+                    await _register_and_prove(client, u, rng, params) for u in users
+                ]
+                # concurrent singles -> coalesced
+                resps = await asyncio.gather(
+                    *[
+                        client.verify_proof(u, cid, pf)
+                        for u, (cid, pf) in zip(users, pairs)
+                    ]
+                )
+                assert all(r.success and r.session_token for r in resps)
+                assert any(s > 1 for s in batcher.dispatched_sizes), (
+                    batcher.dispatched_sizes
+                )
+
+                # batch RPC through the same queue (fresh users)
+                busers = [f"buser{i}" for i in range(5)]
+                pairs2 = [
+                    await _register_and_prove(client, u, rng, params) for u in busers
+                ]
+                br = await client.verify_proof_batch(
+                    busers,
+                    [cid for cid, _ in pairs2],
+                    [pf for _, pf in pairs2],
+                )
+                assert all(r.success for r in br.results)
+        finally:
+            await batcher.stop()
+            await server.stop(None)
+
+    run(main())
+
+
+def test_grpc_tpu_backend_end_to_end():
+    """A real TpuBackend (JAX CPU device here) behind the batcher serves
+    VerifyProof traffic through gRPC — the wiring VERDICT r1 flagged as
+    absent."""
+    from cpzk_tpu.ops.backend import TpuBackend
+
+    async def main():
+        rng = SecureRng()
+        params = Parameters.new()
+        state = ServerState()
+        backend = FailoverBackend(TpuBackend(), CpuBackend())
+        batcher = DynamicBatcher(backend, max_batch=64, window_ms=25.0)
+        server, port = await serve(
+            state, RateLimiter(10_000, 10_000),
+            host="127.0.0.1", port=0, backend=backend, batcher=batcher,
+        )
+        try:
+            async with AuthClient(f"127.0.0.1:{port}") as client:
+                users = [f"tpuuser{i}" for i in range(3)]
+                pairs = [
+                    await _register_and_prove(client, u, rng, params) for u in users
+                ]
+                resps = await asyncio.gather(
+                    *[
+                        client.verify_proof(u, cid, pf)
+                        for u, (cid, pf) in zip(users, pairs)
+                    ]
+                )
+                assert all(r.success and r.session_token for r in resps)
+                assert not backend.degraded  # the JAX path actually served
+        finally:
+            await batcher.stop()
+            await server.stop(None)
+
+    run(main())
