@@ -75,7 +75,7 @@ class _Inputs:
     def __init__(self):
         import numpy as np
 
-        from cpzk_tpu import Parameters, Prover, SecureRng, Transcript, Witness
+        from cpzk_tpu import Parameters, Prover, SecureRng, Transcript, Witness  # noqa: F401
         from cpzk_tpu.core.ristretto import Ristretto255
         from cpzk_tpu.core.scalars import L
 
@@ -86,24 +86,25 @@ class _Inputs:
         # tiling does not flatter the numbers, it only keeps host-side
         # corpus generation out of the budget.  Every tiled row still gets
         # its own random alpha.
-        rows = []
+        from cpzk_tpu.core.transcript import derive_challenges_batch
+
+        proofs = []
         for _ in range(CORPUS):
             prover = Prover(params, Witness(Ristretto255.random_scalar(rng)))
-            proof = prover.prove_with_transcript(rng, Transcript())
-            t2 = Transcript()
-            t2.append_parameters(
-                Ristretto255.element_to_bytes(params.generator_g),
-                Ristretto255.element_to_bytes(params.generator_h),
+            proofs.append(
+                (prover.statement, prover.prove_with_transcript(rng, Transcript()))
             )
-            t2.append_statement(
-                Ristretto255.element_to_bytes(prover.statement.y1),
-                Ristretto255.element_to_bytes(prover.statement.y2),
-            )
-            t2.append_commitment(
-                Ristretto255.element_to_bytes(proof.commitment.r1),
-                Ristretto255.element_to_bytes(proof.commitment.r2),
-            )
-            rows.append((prover.statement, proof, t2.challenge_scalar()))
+        eb = Ristretto255.element_to_bytes
+        challenges = derive_challenges_batch(
+            [None] * CORPUS,
+            [eb(params.generator_g)] * CORPUS,
+            [eb(params.generator_h)] * CORPUS,
+            [eb(st.y1) for st, _ in proofs],
+            [eb(st.y2) for st, _ in proofs],
+            [eb(pr.commitment.r1) for _, pr in proofs],
+            [eb(pr.commitment.r2) for _, pr in proofs],
+        )
+        rows = [(st, pr, ch) for (st, pr), ch in zip(proofs, challenges)]
 
         reps = (N + CORPUS - 1) // CORPUS
         self.tile = lambda cols: np.tile(cols, (1, reps))[:, :N]
@@ -146,8 +147,9 @@ def bench_pippenger(inp: _Inputs) -> float:
 
     from cpzk_tpu.ops.backend import _pad_pow2
 
+    # pad the row count (not the term count): 4*pow2(N)+2 terms, ~0% waste
     m_used = 4 * N + 2
-    m = _pad_pow2(m_used)
+    m = 4 * _pad_pow2(N) + 2
     c = msm.pick_window(m)
     scalars = inp.a + inp.ac + inp.ba + inp.bac + inp.corr
     digits = msm.scalars_to_signed_digits(scalars + [0] * (m - m_used), c)

@@ -1,0 +1,86 @@
+"""Proof-lifecycle micro-benchmarks (host plane).
+
+Mirror of the reference's criterion suite ``benches/proof_generation.rs``
+(groups: generation, verification, serialization — ``proof_generation.rs:8-45``)
+re-expressed for this framework's host path.  Prints one JSON line per
+metric: {"name": ..., "value": ..., "unit": "us/op"}.
+
+Usage: python benches/bench_proof.py [--iters N]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def timeit(fn, iters: int) -> float:
+    """Best-of-runs microseconds per op."""
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            fn()
+        best = min(best, (time.perf_counter() - t0) / iters)
+    return best * 1e6
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--iters", type=int, default=20)
+    args = ap.parse_args()
+
+    from cpzk_tpu import (
+        Parameters,
+        Proof,
+        Prover,
+        SecureRng,
+        Transcript,
+        Verifier,
+        Witness,
+    )
+    from cpzk_tpu.core.ristretto import Ristretto255
+
+    rng = SecureRng()
+    params = Parameters.new()
+    witness = Witness(Ristretto255.random_scalar(rng))
+    prover = Prover(params, witness)
+    proof = prover.prove_with_transcript(rng, Transcript())
+    wire = proof.to_bytes()
+    verifier = Verifier(params, prover.statement)
+
+    out = []
+    out.append(
+        ("proof_generation", timeit(
+            lambda: prover.prove_with_transcript(rng, Transcript()), args.iters))
+    )
+    out.append(
+        ("proof_verification", timeit(
+            lambda: verifier.verify_with_transcript(proof, Transcript()), args.iters))
+    )
+    out.append(("proof_serialization", timeit(lambda: proof.to_bytes(), args.iters)))
+    out.append(
+        ("proof_deserialization", timeit(lambda: Proof.from_bytes(wire), args.iters))
+    )
+    st = prover.statement
+    out.append(
+        ("statement_serialization", timeit(
+            lambda: (
+                Ristretto255.element_to_bytes(st.y1),
+                Ristretto255.element_to_bytes(st.y2),
+            ),
+            args.iters,
+        ))
+    )
+
+    for name, us in out:
+        print(json.dumps({"name": name, "value": round(us, 1), "unit": "us/op"}))
+
+
+if __name__ == "__main__":
+    main()

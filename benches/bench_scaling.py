@@ -1,0 +1,72 @@
+"""Device throughput scaling curve: combined-check proofs/sec over N.
+
+Runs the repo-root ``bench.py`` (device-kernel timing) in one guarded
+subprocess per (N, kernel) configuration — VERDICT r1 asked for a measured
+scaling curve at N in {2k, 16k, 64k} as the credible path toward the
+BASELINE.md north star.  Prints one JSON line per configuration.
+
+Usage: python benches/bench_scaling.py [--sizes 2048,16384,65536]
+       [--kernels rowcombined,pippenger] [--platform cpu] [--guard-secs S]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--sizes", default="2048,16384,65536")
+    ap.add_argument("--kernels", default="rowcombined,pippenger")
+    ap.add_argument("--platform", default=None)
+    ap.add_argument("--guard-secs", type=int, default=1200)
+    ap.add_argument("--iters", type=int, default=3)
+    args = ap.parse_args()
+
+    for n in (int(s) for s in args.sizes.split(",")):
+        for kernel in args.kernels.split(","):
+            env = dict(
+                os.environ,
+                CPZK_BENCH_N=str(n),
+                CPZK_BENCH_KERNEL=kernel,
+                CPZK_BENCH_ITERS=str(args.iters),
+            )
+            if args.platform:
+                env["CPZK_BENCH_PLATFORM"] = args.platform
+            try:
+                proc = subprocess.run(
+                    [sys.executable, os.path.join(ROOT, "bench.py")],
+                    env=env, capture_output=True, text=True,
+                    timeout=args.guard_secs,
+                )
+            except subprocess.TimeoutExpired:
+                print(json.dumps({"name": "combined_check", "kernel": kernel,
+                                  "n": n, "error": "timeout"}))
+                continue
+            if proc.returncode != 0:
+                print(json.dumps({"name": "combined_check", "kernel": kernel,
+                                  "n": n, "error": proc.stderr[-300:]}))
+                continue
+            data = json.loads(proc.stdout.strip().splitlines()[-1])
+            print(
+                json.dumps(
+                    {
+                        "name": "combined_check",
+                        "kernel": kernel,
+                        "n": n,
+                        "value": data["value"],
+                        "unit": "proofs/s",
+                        "vs_baseline": data["vs_baseline"],
+                    }
+                )
+            )
+
+
+if __name__ == "__main__":
+    main()

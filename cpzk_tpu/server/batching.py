@@ -88,6 +88,7 @@ class DynamicBatcher:
     # -- dispatcher --------------------------------------------------------
 
     async def _run(self) -> None:
+        loop = asyncio.get_running_loop()
         while True:
             await self._wakeup.wait()
             self._wakeup.clear()
@@ -95,10 +96,19 @@ class DynamicBatcher:
                 if self._stopping:
                     return
                 continue
-            # deadline window: let concurrent requests pile in, unless the
-            # batch is already full or we're draining for shutdown
-            if len(self._queue) < self.max_batch and not self._stopping:
-                await asyncio.sleep(self.window)
+            # deadline window: let concurrent requests pile in, but dispatch
+            # immediately once a full device batch is queued (the wakeup
+            # event interrupts the wait) or when draining for shutdown
+            deadline = loop.time() + self.window
+            while len(self._queue) < self.max_batch and not self._stopping:
+                remaining = deadline - loop.time()
+                if remaining <= 0:
+                    break
+                try:
+                    await asyncio.wait_for(self._wakeup.wait(), remaining)
+                    self._wakeup.clear()
+                except asyncio.TimeoutError:
+                    break
 
             while self._queue:
                 take = self._queue[: self.max_batch]

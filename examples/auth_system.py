@@ -1,0 +1,117 @@
+"""A complete password-based authentication flow, simulated in-process.
+
+Didactic twin of the reference's ``examples/auth_system.rs`` (17-124): a
+tiny in-memory "server" registers users by their public statements and
+authenticates login attempts with single-use challenges; the "client"
+derives its secret from a password.  Demonstrates the two attacks the
+protocol defeats:
+
+- replay: re-sending a captured proof fails because the challenge context
+  is single-use and bound into the transcript;
+- wrong secret: proving with the wrong password fails verification.
+
+Run: python examples/auth_system.py
+"""
+
+import os
+import secrets
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from cpzk_tpu import (  # noqa: E402
+    Error,
+    Parameters,
+    Proof,
+    Prover,
+    SecureRng,
+    Statement,
+    Transcript,
+    Verifier,
+    Witness,
+)
+from cpzk_tpu.client.kdf import password_to_scalar  # noqa: E402
+
+
+class TinyAuthServer:
+    """In-memory registry + single-use challenges (the gRPC server's logic
+    without the transport; see cpzk_tpu.server for the real one)."""
+
+    def __init__(self):
+        self.params = Parameters.new()
+        self.users: dict[str, Statement] = {}
+        self.challenges: dict[bytes, str] = {}
+
+    def register(self, user: str, statement: Statement) -> None:
+        if user in self.users:
+            raise ValueError(f"user {user!r} already registered")
+        statement.validate()
+        self.users[user] = statement
+
+    def issue_challenge(self, user: str) -> bytes:
+        challenge_id = secrets.token_bytes(32)
+        self.challenges[challenge_id] = user
+        return challenge_id
+
+    def verify_login(self, user: str, challenge_id: bytes, wire: bytes) -> bool:
+        # consume-once BEFORE verification: a replayed id is already gone
+        owner = self.challenges.pop(challenge_id, None)
+        if owner != user or user not in self.users:
+            return False
+        try:
+            proof = Proof.from_bytes(wire)
+            transcript = Transcript()
+            transcript.append_context(challenge_id)
+            Verifier(self.params, self.users[user]).verify_with_transcript(
+                proof, transcript
+            )
+            return True
+        except Error:
+            return False
+
+
+def login(server: TinyAuthServer, user: str, password: str, rng: SecureRng) -> tuple[bytes, bytes]:
+    """Client side: challenge -> proof bound to it. Returns (cid, wire)."""
+    x = password_to_scalar(password, user)
+    prover = Prover(server.params, Witness(x))
+    challenge_id = server.issue_challenge(user)
+    transcript = Transcript()
+    transcript.append_context(challenge_id)
+    proof = prover.prove_with_transcript(rng, transcript)
+    return challenge_id, proof.to_bytes()
+
+
+def main() -> None:
+    rng = SecureRng()
+    server = TinyAuthServer()
+
+    # --- registration: the server only ever sees the public statement
+    x = password_to_scalar("correct horse battery staple", "alice")
+    statement = Prover(server.params, Witness(x)).statement
+    server.register("alice", statement)
+    print("registered alice (server stores y1, y2 — never the password)")
+
+    # --- successful login
+    cid, wire = login(server, "alice", "correct horse battery staple", rng)
+    assert server.verify_login("alice", cid, wire)
+    print("login ok: correct password produces an accepted proof")
+
+    # --- attack 1: replaying the captured proof fails (challenge consumed)
+    assert not server.verify_login("alice", cid, wire)
+    print("replay defeated: the challenge is single-use")
+
+    # --- attack 2: wrong password fails verification
+    cid2, wire2 = login(server, "alice", "hunter2", rng)
+    assert not server.verify_login("alice", cid2, wire2)
+    print("wrong secret defeated: proof does not match the registered statement")
+
+    # --- attack 3: proof for one challenge cannot answer another
+    cid3, wire3 = login(server, "alice", "correct horse battery staple", rng)
+    cid4 = server.issue_challenge("alice")
+    assert not server.verify_login("alice", cid4, wire3)
+    del cid3
+    print("context binding holds: a proof answers exactly one challenge")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,53 @@
+"""Hello, Chaum-Pedersen: prove knowledge of a secret and verify it.
+
+Didactic twin of the reference's ``examples/hello_world.rs`` (1-59): create
+a witness, derive the public statement, produce a non-interactive proof,
+round-trip it through the 109-byte wire format, and verify.
+
+Run: python examples/hello_world.py
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from cpzk_tpu import (  # noqa: E402
+    Parameters,
+    Proof,
+    Prover,
+    SecureRng,
+    Transcript,
+    Verifier,
+    Witness,
+)
+from cpzk_tpu.core.ristretto import Ristretto255  # noqa: E402
+
+
+def main() -> None:
+    rng = SecureRng()
+
+    # 1. Public parameters: the two independent group generators (g, h).
+    params = Parameters.new()
+
+    # 2. The prover's secret x and its public statement (y1, y2) = (g^x, h^x).
+    witness = Witness(Ristretto255.random_scalar(rng))
+    prover = Prover(params, witness)
+    statement = prover.statement
+    print("statement y1:", Ristretto255.element_to_bytes(statement.y1).hex())
+    print("statement y2:", Ristretto255.element_to_bytes(statement.y2).hex())
+
+    # 3. Non-interactive proof via the Fiat-Shamir transcript.
+    proof = prover.prove_with_transcript(rng, Transcript())
+    wire = proof.to_bytes()
+    print(f"proof: {len(wire)} bytes on the wire")
+
+    # 4. Anyone holding the statement can verify the proof.
+    verifier = Verifier(params, statement)
+    verifier.verify_with_transcript(Proof.from_bytes(wire), Transcript())
+    print("proof verified: the prover knows x with y1 = g^x AND y2 = h^x")
+    print("...without revealing x.")
+
+
+if __name__ == "__main__":
+    main()
