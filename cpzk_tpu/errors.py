@@ -1,8 +1,7 @@
 """Shared error taxonomy.
 
 Mirrors the reference's three-variant error enum (``src/error.rs:4-17``):
-``InvalidParams``, ``InvalidScalar``, ``InvalidGroupElement``. The C++ host
-library uses matching integer status codes (see ``core/cpp/``, planned native host library).
+``InvalidParams``, ``InvalidScalar``, ``InvalidGroupElement``.
 """
 
 
