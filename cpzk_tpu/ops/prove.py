@@ -1,0 +1,167 @@
+"""TPU batch proof generation (BASELINE config 3; reference analog
+``src/prover/mod.rs:115-131`` scaled to device batches).
+
+Commitments (R1, R2) = (k·G, k·H) and statements (Y1, Y2) = (x·G, x·H) are
+*fixed-base* scalar multiplications, so the kernel uses a comb method: for
+each generator, precompute per-window tables T_w[j] = j·16^w·P (64 windows
+x 16 entries, built once on device by a tiny scan program), then each point
+is just 64 table-selects + adds per lane — **zero doublings**, ~5x fewer
+point-ops than a variable-base ladder.  Ristretto encoding also happens on
+device; the host only draws nonces, derives Fiat-Shamir challenges (C++
+transcript core), and closes the responses s = k + c·x mod l.
+
+SECURITY (docs/security.md, SURVEY.md §7 hard part 5): batch proving places
+secrets (k, x) in device HBM as public-layout digit arrays.  Device memory
+cannot be meaningfully zeroized and XLA may checkpoint buffers — this path
+trusts the whole accelerator host and is OPT-IN for bulk workloads
+(test-corpus generation, load benches, migration tooling).  Interactive
+single-user proving belongs on the host path (``protocol.Prover``).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.ristretto import Ristretto255, Scalar
+from ..core.rng import SecureRng
+from ..core.scalars import L
+from ..core.transcript import derive_challenges_batch
+from ..protocol.gadgets import PROTOCOL_VERSION, Parameters, frame_fields
+from . import curve
+from .backend import _pad_pow2
+from .curve import NWINDOWS, Point, build_table, table_gather
+
+
+def _comb_tables_kernel(p: Point):
+    """[64, 16, 20, 1] per-window tables T_w[j] = j * 16^w * P."""
+
+    def step(base: Point, _):
+        table = build_table(base)  # [16, 20, 1] coords
+        nb = base
+        for _ in range(4):
+            nb = curve.double(nb)  # next window base: 16 * base
+        return nb, table
+
+    _, tables = lax.scan(step, p, None, length=NWINDOWS)
+    return tables
+
+
+def _fixed_base_kernel(tables, digits: jnp.ndarray) -> Point:
+    """sum_w T_w[digit_w] per lane; ``digits`` [64, n] LSB window first."""
+
+    def step(acc: Point, tw_d):
+        table, d = tw_d
+        return curve.add(acc, table_gather(table, d)), None
+
+    acc, _ = lax.scan(step, curve.identity((digits.shape[-1],)), (tables, digits))
+    return acc
+
+
+@jax.jit
+def _commitments_kernel(tg, th, digits):
+    """digits [64, n] -> (R1 wire bytes [32, n], R2 wire bytes [32, n])."""
+    r1 = _fixed_base_kernel(tg, digits)
+    r2 = _fixed_base_kernel(th, digits)
+    return curve.encode(r1), curve.encode(r2)
+
+
+def _windows_lsb(values: list[int]) -> jnp.ndarray:
+    """[64, n] 4-bit windows, least-significant window first (comb order)."""
+    return jnp.asarray(curve.scalars_to_windows(values)[::-1].copy())
+
+
+class BatchProver:
+    """Bulk proof generation on the device data plane.
+
+    >>> bp = BatchProver(Parameters.new())
+    >>> statements, proofs = bp.prove(witnesses, contexts, rng)
+
+    Returns per-proof ((y1_bytes, y2_bytes), proof_wire_bytes); the wire
+    bytes parse under ``Proof.from_bytes`` and verify with the standard
+    ``Verifier`` — differential tests in ``tests/test_batch_prove.py``.
+    """
+
+    def __init__(self, params: Parameters | None = None):
+        self.params = params or Parameters.new()
+        g = curve.points_to_device([self.params.generator_g.point])
+        h = curve.points_to_device([self.params.generator_h.point])
+        build = jax.jit(_comb_tables_kernel)
+        self._tg = jax.block_until_ready(build(g))
+        self._th = jax.block_until_ready(build(h))
+
+    def _fixed_base_bytes(self, scalars: list[int]) -> tuple[np.ndarray, np.ndarray]:
+        """(P1, P2) wire bytes for (k·G, k·H) per scalar, pow2-padded jit."""
+        n = len(scalars)
+        pad = _pad_pow2(n)
+        digits = _windows_lsb(scalars + [0] * (pad - n))
+        b1, b2 = _commitments_kernel(self._tg, self._th, digits)
+        return (
+            np.asarray(b1, dtype=np.uint8)[:, :n],
+            np.asarray(b2, dtype=np.uint8)[:, :n],
+        )
+
+    def statements(self, witnesses: list[Scalar]) -> list[tuple[bytes, bytes]]:
+        """(y1, y2) wire bytes per witness (registration-side bulk helper)."""
+        y1b, y2b = self._fixed_base_bytes([w.value for w in witnesses])
+        return [
+            (y1b[:, i].tobytes(), y2b[:, i].tobytes()) for i in range(len(witnesses))
+        ]
+
+    def prove(
+        self,
+        witnesses: list[Scalar],
+        contexts: list[bytes | None] | None = None,
+        rng: SecureRng | None = None,
+        statements: list[tuple[bytes, bytes]] | None = None,
+    ) -> tuple[list[tuple[bytes, bytes]], list[bytes]]:
+        """NIZK proofs for every witness -> (statements, proof wire bytes).
+
+        ``statements`` skips the statement recomputation when the caller
+        already holds the registered (y1, y2) bytes.
+        """
+        rng = rng or SecureRng()
+        n = len(witnesses)
+        contexts = contexts if contexts is not None else [None] * n
+        if len(contexts) != n:
+            raise ValueError("contexts length mismatch")
+        if statements is not None and len(statements) != n:
+            raise ValueError("statements length mismatch")
+
+        xs = [w.value for w in witnesses]
+        if statements is None:
+            statements = self.statements(witnesses)
+
+        # nonces on the host CSPRNG; commitments on device
+        ks = [Ristretto255.random_scalar(rng).value for _ in range(n)]
+        r1b, r2b = self._fixed_base_bytes(ks)
+        r1s = [r1b[:, i].tobytes() for i in range(n)]
+        r2s = [r2b[:, i].tobytes() for i in range(n)]
+
+        gb = Ristretto255.element_to_bytes(self.params.generator_g)
+        hb = Ristretto255.element_to_bytes(self.params.generator_h)
+        challenges = derive_challenges_batch(
+            contexts,
+            [gb] * n,
+            [hb] * n,
+            [st[0] for st in statements],
+            [st[1] for st in statements],
+            r1s,
+            r2s,
+        )
+
+        proofs = []
+        for i in range(n):
+            s = (ks[i] + challenges[i].value * xs[i]) % L
+            proofs.append(
+                frame_fields(
+                    PROTOCOL_VERSION, r1s[i], r2s[i], s.to_bytes(32, "little")
+                )
+            )
+        return statements, proofs
+
+
+__all__ = ["BatchProver"]

@@ -16,6 +16,17 @@ from ..core.ristretto import Element, Ristretto255, Scalar
 
 PROTOCOL_VERSION = 1
 
+
+def frame_fields(version: int, *fields: bytes) -> bytes:
+    """The proof wire framing: ``[ver u8]`` then u32-BE length-prefixed
+    fields.  Single source of truth for every proof emitter (``Proof``,
+    the TPU ``BatchProver``)."""
+    out = bytearray([version])
+    for field in fields:
+        out += len(field).to_bytes(4, "big")
+        out += field
+    return bytes(out)
+
 MAX_ELEMENT_SIZE = 4096
 MAX_SCALAR_SIZE = 512
 MIN_PROOF_SIZE = 1 + 4 + 1 + 4 + 1 + 4 + 1
@@ -121,14 +132,12 @@ class Proof:
 
     def to_bytes(self) -> bytes:
         """Wire format: ``[ver u8][len u32_be|r1][len|r2][len|s]`` = 109 bytes."""
-        r1 = Ristretto255.element_to_bytes(self.commitment.r1)
-        r2 = Ristretto255.element_to_bytes(self.commitment.r2)
-        s = Ristretto255.scalar_to_bytes(self.response.s)
-        out = bytearray([self.version])
-        for field in (r1, r2, s):
-            out += len(field).to_bytes(4, "big")
-            out += field
-        return bytes(out)
+        return frame_fields(
+            self.version,
+            Ristretto255.element_to_bytes(self.commitment.r1),
+            Ristretto255.element_to_bytes(self.commitment.r2),
+            Ristretto255.scalar_to_bytes(self.response.s),
+        )
 
     @staticmethod
     def from_bytes(data: bytes) -> "Proof":

@@ -37,19 +37,22 @@ def _c(color: str, text: str) -> str:
 
 
 def parse_args(argv=None) -> argparse.Namespace:
+    """CLI flags are the TOP config layer: every flag defaults to None and
+    only overrides the resolved config when explicitly provided — env vars
+    (SERVER_HOST, SERVER_RATE_LIMIT_REQUESTS_PER_MINUTE, ...) and .env are
+    handled by ``ServerConfig.from_env`` so precedence stays
+    defaults < TOML < .env < env < CLI (the reference never reconciles
+    these layers — SURVEY.md §3.3)."""
     p = argparse.ArgumentParser(prog="cpzk-server", description="Chaum-Pedersen auth server")
-    p.add_argument("-H", "--host", default=os.environ.get("SERVER_HOST", "127.0.0.1"))
-    p.add_argument("-p", "--port", type=int, default=int(os.environ.get("SERVER_PORT", "50051")))
-    p.add_argument("--metrics", action="store_true",
-                   default=os.environ.get("SERVER_METRICS", "").lower() in ("1", "true"))
-    p.add_argument("--metrics-port", type=int,
-                   default=int(os.environ.get("SERVER_METRICS_PORT", "9090")))
-    p.add_argument("--rate-limit", type=int,
-                   default=int(os.environ.get("SERVER_RATE_LIMIT", "100")))
-    p.add_argument("--rate-burst", type=int,
-                   default=int(os.environ.get("SERVER_RATE_BURST", "10")))
-    p.add_argument("--backend", choices=("cpu", "tpu"),
-                   default=os.environ.get("SERVER_TPU_BACKEND", None),
+    p.add_argument("-H", "--host", default=None)
+    p.add_argument("-p", "--port", type=int, default=None)
+    p.add_argument("--metrics", action="store_true", default=None,
+                   help="enable the Prometheus exporter")
+    p.add_argument("--metrics-port", type=int, default=None)
+    p.add_argument("--rate-limit", type=int, default=None,
+                   help="requests per minute")
+    p.add_argument("--rate-burst", type=int, default=None)
+    p.add_argument("--backend", choices=("cpu", "tpu"), default=None,
                    help="verifier backend: cpu (inline host verify) or tpu "
                         "(JAX data plane + dynamic batching + CPU failover)")
     p.add_argument("--batch-max", type=int, default=None,
@@ -155,21 +158,22 @@ async def handle_command(cmd: str, state: ServerState) -> tuple[str, bool]:
     return f"Unknown command: {word}. Type /help for available commands.", False
 
 
-async def amain(args) -> None:
-    logging.basicConfig(
-        level=os.environ.get("RUST_LOG", os.environ.get("LOG_LEVEL", "INFO")).upper(),
-        format="%(asctime)s %(levelname)s %(name)s: %(message)s",
-    )
-
+def resolve_config(args) -> ServerConfig:
+    """defaults < TOML < .env < SERVER_* env < explicitly-provided CLI flags
+    (the reference leaves CLI/figment unreconciled — SURVEY.md §3.3)."""
     config = ServerConfig.from_env()
-    # CLI flags override (the reference leaves these unreconciled; here the
-    # resolved config is the single source — SURVEY.md §3.3)
-    config.host = args.host
-    config.port = args.port
-    config.rate_limit.requests_per_minute = args.rate_limit
-    config.rate_limit.burst = args.rate_burst
-    config.metrics.enabled = args.metrics
-    config.metrics.port = args.metrics_port
+    if args.host is not None:
+        config.host = args.host
+    if args.port is not None:
+        config.port = args.port
+    if args.rate_limit is not None:
+        config.rate_limit.requests_per_minute = args.rate_limit
+    if args.rate_burst is not None:
+        config.rate_limit.burst = args.rate_burst
+    if args.metrics is not None:
+        config.metrics.enabled = args.metrics
+    if args.metrics_port is not None:
+        config.metrics.port = args.metrics_port
     if args.backend is not None:
         config.tpu.backend = args.backend
     if args.batch_max is not None:
@@ -177,6 +181,17 @@ async def amain(args) -> None:
     if args.batch_window_ms is not None:
         config.tpu.batch_window_ms = args.batch_window_ms
     config.validate()
+    return config
+
+
+async def amain(args) -> None:
+    # resolve config first so .env-provided RUST_LOG/LOG_LEVEL reach logging
+    config = resolve_config(args)
+
+    logging.basicConfig(
+        level=os.environ.get("RUST_LOG", os.environ.get("LOG_LEVEL", "INFO")).upper(),
+        format="%(asctime)s %(levelname)s %(name)s: %(message)s",
+    )
 
     state = ServerState()
     limiter = config.rate_limit.build_limiter()

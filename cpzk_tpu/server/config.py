@@ -29,7 +29,7 @@ class RateLimitSettings:
 
 @dataclass
 class MetricsSettings:
-    enabled: bool = True
+    enabled: bool = False  # opt-in, like the reference's --metrics flag
     host: str = "127.0.0.1"
     port: int = 9090
 

@@ -1,0 +1,83 @@
+"""Differential tests: TPU batch proof generation (fixed-base comb kernel)
+vs the host prover/verifier (VERDICT r1 missing item 8; BASELINE config 3;
+reference analog ``src/prover/mod.rs:115-131``).
+"""
+
+import pytest
+
+from cpzk_tpu import (
+    Parameters,
+    Proof,
+    SecureRng,
+    Statement,
+    Transcript,
+    Verifier,
+    Witness,
+)
+from cpzk_tpu.core.ristretto import Ristretto255
+
+
+@pytest.fixture(scope="module")
+def bp():
+    from cpzk_tpu.ops.prove import BatchProver
+
+    return BatchProver(Parameters.new())
+
+
+def test_statements_match_host(bp):
+    rng = SecureRng()
+    witnesses = [Ristretto255.random_scalar(rng) for _ in range(5)]
+    got = bp.statements(witnesses)
+    for w, (y1b, y2b) in zip(witnesses, got):
+        st = Statement.from_witness(bp.params, Witness(w))
+        assert y1b == Ristretto255.element_to_bytes(st.y1)
+        assert y2b == Ristretto255.element_to_bytes(st.y2)
+
+
+def test_batch_proofs_verify(bp):
+    rng = SecureRng()
+    n = 6
+    witnesses = [Ristretto255.random_scalar(rng) for _ in range(n)]
+    contexts = [None, b"ctx-1", b"ctx-2", None, b"ctx-4", b"ctx-5"]
+    statements, proofs = bp.prove(witnesses, contexts, rng)
+
+    for w, ctx, (y1b, y2b), wire in zip(witnesses, contexts, statements, proofs):
+        assert len(wire) == 109
+        proof = Proof.from_bytes(wire)  # full adversarial parser accepts
+        st = Statement(
+            Ristretto255.element_from_bytes(y1b),
+            Ristretto255.element_from_bytes(y2b),
+        )
+        t = Transcript()
+        if ctx is not None:
+            t.append_context(ctx)
+        Verifier(bp.params, st).verify_with_transcript(proof, t)
+
+    # context binding: proof i must not verify under context j
+    proof0 = Proof.from_bytes(proofs[0])
+    st1 = Statement(
+        Ristretto255.element_from_bytes(statements[0][0]),
+        Ristretto255.element_from_bytes(statements[0][1]),
+    )
+    t = Transcript()
+    t.append_context(b"ctx-1")
+    from cpzk_tpu import Error
+
+    with pytest.raises(Error):
+        Verifier(bp.params, st1).verify_with_transcript(proof0, t)
+
+
+def test_precomputed_statements_path(bp):
+    rng = SecureRng()
+    witnesses = [Ristretto255.random_scalar(rng) for _ in range(3)]
+    statements = bp.statements(witnesses)
+    st2, proofs = bp.prove(witnesses, None, rng, statements=statements)
+    assert st2 == statements
+    for (y1b, y2b), wire in zip(st2, proofs):
+        st = Statement(
+            Ristretto255.element_from_bytes(y1b),
+            Ristretto255.element_from_bytes(y2b),
+        )
+        Verifier(bp.params, st).verify_with_transcript(
+            Proof.from_bytes(wire), Transcript()
+        )

@@ -1,0 +1,88 @@
+"""Config layering: defaults < TOML < .env < SERVER_* env < CLI flags —
+the reconciliation the reference never does (SURVEY.md §3.3 split-brain:
+figment config is validated but clap args win unconditionally).
+"""
+
+import os
+
+import pytest
+
+from cpzk_tpu.server.__main__ import parse_args, resolve_config
+from cpzk_tpu.server.config import ServerConfig
+
+
+@pytest.fixture()
+def clean_env(tmp_path, monkeypatch):
+    for key in list(os.environ):
+        if key.startswith("SERVER_"):
+            monkeypatch.delenv(key)
+    monkeypatch.chdir(tmp_path)  # isolate .env discovery
+    monkeypatch.setenv("SERVER_CONFIG_PATH", str(tmp_path / "server.toml"))
+    return tmp_path
+
+
+def test_defaults(clean_env):
+    cfg = resolve_config(parse_args([]))
+    assert (cfg.host, cfg.port) == ("127.0.0.1", 50051)
+    assert cfg.rate_limit.requests_per_minute == 100
+    assert cfg.metrics.enabled is False
+    assert cfg.tpu.backend == "cpu"
+
+
+def test_toml_layer_survives_argparse(clean_env):
+    (clean_env / "server.toml").write_text(
+        'host = "0.0.0.0"\nport = 60000\n'
+        "[rate_limit]\nrequests_per_minute = 500\n"
+        "[metrics]\nenabled = true\n"
+        '[tpu]\nbackend = "tpu"\nbatch_max = 128\n'
+    )
+    cfg = resolve_config(parse_args([]))
+    assert (cfg.host, cfg.port) == ("0.0.0.0", 60000)
+    assert cfg.rate_limit.requests_per_minute == 500
+    assert cfg.metrics.enabled is True
+    assert (cfg.tpu.backend, cfg.tpu.batch_max) == ("tpu", 128)
+
+
+def test_env_overrides_toml(clean_env, monkeypatch):
+    (clean_env / "server.toml").write_text('port = 60000\n')
+    monkeypatch.setenv("SERVER_PORT", "61000")
+    monkeypatch.setenv("SERVER_RATE_LIMIT_REQUESTS_PER_MINUTE", "42")
+    monkeypatch.setenv("SERVER_TPU_BATCH_WINDOW_MS", "9.5")
+    cfg = resolve_config(parse_args([]))
+    assert cfg.port == 61000
+    assert cfg.rate_limit.requests_per_minute == 42
+    assert cfg.tpu.batch_window_ms == 9.5
+
+
+def test_dotenv_under_env(clean_env, monkeypatch):
+    (clean_env / ".env").write_text(
+        "SERVER_PORT=59000\nSERVER_METRICS_ENABLED=true\n"
+    )
+    monkeypatch.setenv("SERVER_PORT", "58000")  # real env beats .env
+    cfg = resolve_config(parse_args([]))
+    assert cfg.port == 58000
+    assert cfg.metrics.enabled is True
+
+
+def test_cli_is_top_layer(clean_env, monkeypatch):
+    (clean_env / "server.toml").write_text('port = 60000\nhost = "0.0.0.0"\n')
+    monkeypatch.setenv("SERVER_PORT", "61000")
+    cfg = resolve_config(
+        parse_args(["--port", "62000", "--rate-limit", "7", "--backend", "tpu"])
+    )
+    assert cfg.port == 62000          # CLI beats env beats TOML
+    assert cfg.host == "0.0.0.0"      # unset flags leave lower layers intact
+    assert cfg.rate_limit.requests_per_minute == 7
+    assert cfg.tpu.backend == "tpu"
+
+
+def test_validation_still_runs(clean_env):
+    with pytest.raises(ValueError):
+        resolve_config(parse_args(["--rate-limit", "0"]))
+
+
+def test_unknown_backend_rejected(clean_env):
+    cfg = ServerConfig()
+    cfg.tpu.backend = "gpu"
+    with pytest.raises(ValueError):
+        cfg.validate()
