@@ -20,12 +20,12 @@ _lib: ctypes.CDLL | None = None
 _tried = False
 
 
-def _build() -> bool:
+def _build(force: bool = False) -> bool:
     if os.environ.get("CPZK_NO_NATIVE_BUILD"):
         return False
     try:
         subprocess.run(
-            ["make", "-s"],
+            ["make", "-s"] + (["-B"] if force else []),
             cwd=_SRC_DIR,
             check=True,
             capture_output=True,
@@ -66,8 +66,62 @@ def load() -> ctypes.CDLL | None:
         ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p,
         ctypes.c_int,
     ]
+
+    # ristretto verification core: force-rebuild once if the .so predates
+    # it, but never discard a working (older, merlin-only) library — a
+    # failed rebuild keeps the old file and the old capabilities
+    if not hasattr(lib, "cpzk_verify_rows") and _build(force=True):
+        try:
+            relib = ctypes.CDLL(_LIB_PATH)
+        except OSError:
+            relib = None
+        if relib is not None and hasattr(relib, "cpzk_verify_rows"):
+            lib = relib
+            lib.cpzk_transcript_new.restype = ctypes.c_void_p
+            lib.cpzk_transcript_new.argtypes = [ctypes.c_char_p, ctypes.c_size_t]
+            lib.cpzk_transcript_free.argtypes = [ctypes.c_void_p]
+            lib.cpzk_transcript_append.argtypes = [
+                ctypes.c_void_p, ctypes.c_char_p, ctypes.c_size_t,
+                ctypes.c_char_p, ctypes.c_size_t,
+            ]
+            lib.cpzk_transcript_challenge.argtypes = [
+                ctypes.c_void_p, ctypes.c_char_p, ctypes.c_size_t,
+                ctypes.c_char_p, ctypes.c_size_t,
+            ]
+            lib.cpzk_challenge_batch.argtypes = [
+                ctypes.c_size_t, ctypes.c_char_p, ctypes.POINTER(ctypes.c_uint32),
+                ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p,
+                ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p,
+                ctypes.c_int,
+            ]
+
+    if hasattr(lib, "cpzk_verify_rows"):
+        lib.cpzk_verify_rows.restype = ctypes.c_int
+        lib.cpzk_verify_rows.argtypes = [
+            ctypes.c_size_t, ctypes.c_char_p, ctypes.c_char_p,
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p,
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int,
+        ]
+        lib.cpzk_point_roundtrip.restype = ctypes.c_int
+        lib.cpzk_point_roundtrip.argtypes = [ctypes.c_char_p, ctypes.c_char_p]
+        lib.cpzk_scalarmul.restype = ctypes.c_int
+        lib.cpzk_scalarmul.argtypes = [
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p,
+        ]
+        lib.cpzk_point_add.restype = ctypes.c_int
+        lib.cpzk_point_add.argtypes = [
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p,
+        ]
     _lib = lib
     return _lib
+
+
+def _ristretto_lib():
+    """The library iff it exports the ristretto verification core."""
+    lib = load()
+    if lib is None or not hasattr(lib, "cpzk_verify_rows"):
+        return None
+    return lib
 
 
 def challenge_batch(
@@ -104,6 +158,74 @@ def challenge_batch(
     lib.cpzk_challenge_batch(
         n, blob, offsets, has_ctx, gs, hs, y1s, y2s, r1s, r2s, out, threads
     )
+    return out.raw
+
+
+def verify_rows(
+    g: bytes,
+    h: bytes,
+    y1s: bytes,
+    y2s: bytes,
+    r1s: bytes,
+    r2s: bytes,
+    ss: bytes,
+    cs: bytes,
+    threads: int = 0,
+) -> list[bool] | None:
+    """Verify n Chaum-Pedersen rows natively (s*G == R1 + c*Y1 and the H/Y2
+    twin; reference ``verifier/mod.rs:144-171``); None if the library is
+    absent.  ``g``/``h`` are the shared 32-byte generators; the six column
+    args are n*32-byte concatenations of wire encodings."""
+    lib = _ristretto_lib()
+    if lib is None:
+        return None
+    if len(g) != 32 or len(h) != 32:
+        raise ValueError("g and h must be 32-byte encodings")
+    n = len(ss) // 32
+    for name, col in (("y1s", y1s), ("y2s", y2s), ("r1s", r1s),
+                      ("r2s", r2s), ("ss", ss), ("cs", cs)):
+        if len(col) != 32 * n:
+            raise ValueError(f"{name} must be {32 * n} bytes (n*32), got {len(col)}")
+    if threads <= 0:
+        threads = min(os.cpu_count() or 1, max(1, n))
+    out = ctypes.create_string_buffer(n)
+    lib.cpzk_verify_rows(n, g, h, y1s, y2s, r1s, r2s, ss, cs, out, threads)
+    return [b == 1 for b in out.raw]
+
+
+def point_roundtrip(wire: bytes) -> bytes | None:
+    """Decode+re-encode via the native core; None if unavailable, b"" if
+    the encoding is rejected."""
+    lib = _ristretto_lib()
+    if lib is None or len(wire) != 32:
+        return None if lib is None else b""
+    out = ctypes.create_string_buffer(32)
+    if not lib.cpzk_point_roundtrip(wire, out):
+        return b""  # decode rejected
+    return out.raw
+
+
+def scalarmul(point: bytes, scalar: bytes) -> bytes | None:
+    lib = _ristretto_lib()
+    if lib is None:
+        return None
+    if len(point) != 32 or len(scalar) != 32:
+        raise ValueError("point and scalar must be 32 bytes")
+    out = ctypes.create_string_buffer(32)
+    if not lib.cpzk_scalarmul(point, scalar, out):
+        return b""
+    return out.raw
+
+
+def point_add(a: bytes, b: bytes) -> bytes | None:
+    lib = _ristretto_lib()
+    if lib is None:
+        return None
+    if len(a) != 32 or len(b) != 32:
+        raise ValueError("points must be 32 bytes")
+    out = ctypes.create_string_buffer(32)
+    if not lib.cpzk_point_add(a, b, out):
+        return b""
     return out.raw
 
 

@@ -14,7 +14,7 @@ import hashlib
 import hmac
 
 from ..errors import InvalidGroupElement, InvalidScalar
-from . import edwards, scalars
+from . import _native, edwards, scalars
 from .rng import SecureRng
 
 RISTRETTO_BYTES = 32
@@ -46,33 +46,67 @@ class Scalar:
 
 
 class Element:
-    """Ristretto255 group element (point coset)."""
+    """Ristretto255 group element (point coset).
 
-    __slots__ = ("point",)
+    Dual representation, each computed lazily from the other and cached:
 
-    def __init__(self, point: edwards.Point):
-        self.point = point
+    - ``point`` — extended Edwards coordinates for the pure-Python ops;
+    - ``wire()`` — the canonical 32-byte encoding, which is what the C++
+      host core and the TPU data plane consume.
+
+    Elements entering from the network carry both (decode validates);
+    elements produced by the native group ops carry wire bytes only and
+    decode on first ``.point`` access (rare: only the pure-Python fallback
+    paths need coordinates).
+    """
+
+    __slots__ = ("_point", "_wire")
+
+    def __init__(self, point: edwards.Point | None = None, wire: bytes | None = None):
+        if point is None and wire is None:
+            raise ValueError("Element needs a point or wire bytes")
+        self._point = point
+        self._wire = wire
+
+    @property
+    def point(self) -> edwards.Point:
+        if self._point is None:
+            self._point = edwards.ristretto_decode(self._wire)
+            if self._point is None:  # native core produced it; cannot happen
+                raise InvalidGroupElement("Corrupt cached encoding")
+        return self._point
+
+    def wire(self) -> bytes:
+        """Canonical encoding, cached after first computation."""
+        if self._wire is None:
+            self._wire = edwards.ristretto_encode(self._point)
+        return self._wire
 
     def __eq__(self, other: object) -> bool:
         if not isinstance(other, Element):
             return NotImplemented
+        if self._wire is not None and other._wire is not None:
+            return self._wire == other._wire
         return edwards.pt_eq(self.point, other.point)
 
     def __hash__(self) -> int:
-        return hash(edwards.ristretto_encode(self.point))
+        return hash(self.wire())
 
     def __repr__(self) -> str:
-        return f"Element({edwards.ristretto_encode(self.point).hex()})"
+        return f"Element({self.wire().hex()})"
 
 
 class Ristretto255:
     """Static namespace mirroring the reference group API."""
 
+    _GENERATOR_G_CACHE: Element | None = None
     _GENERATOR_H_CACHE: Element | None = None
 
-    @staticmethod
-    def generator_g() -> Element:
-        return Element(edwards.BASEPOINT)
+    @classmethod
+    def generator_g(cls) -> Element:
+        if cls._GENERATOR_G_CACHE is None:
+            cls._GENERATOR_G_CACHE = Element(edwards.BASEPOINT)
+        return cls._GENERATOR_G_CACHE
 
     @classmethod
     def generator_h(cls) -> Element:
@@ -102,11 +136,11 @@ class Ristretto255:
         point = edwards.ristretto_decode(data)
         if point is None:
             raise InvalidGroupElement("Bytes do not represent a valid Ristretto point")
-        return Element(point)
+        return Element(point, bytes(data))
 
     @staticmethod
     def element_to_bytes(element: Element) -> bytes:
-        return edwards.ristretto_encode(element.point)
+        return element.wire()
 
     @staticmethod
     def random_scalar(rng: SecureRng) -> Scalar:
@@ -114,29 +148,49 @@ class Ristretto255:
 
     @staticmethod
     def scalar_mul(element: Element, scalar: Scalar) -> Element:
+        """scalar * element, through the C++ host core when available
+        (bit-exact vs the Python path per tests/test_native.py).  Both
+        paths are variable-time — see docs/security.md."""
+        if scalar.value == 0:
+            return Ristretto255.identity()
+        out = _native.scalarmul(element.wire(), scalars.sc_to_bytes(scalar.value))
+        if out:  # None = no library; b"" = decode failure (fall through)
+            return Element(wire=out)
         return Element(edwards.pt_scalar_mul(element.point, scalar.value))
 
     @staticmethod
     def element_mul(a: Element, b: Element) -> Element:
         """Group operation (written multiplicatively in the protocol; the
         curve implementation is additive) — ristretto.rs:158-160."""
+        out = _native.point_add(a.wire(), b.wire())
+        if out:
+            return Element(wire=out)
         return Element(edwards.pt_add(a.point, b.point))
 
     @staticmethod
     def identity() -> Element:
-        return Element(edwards.IDENTITY)
+        return Element(edwards.IDENTITY, bytes(RISTRETTO_BYTES))
 
     @staticmethod
     def is_identity(element: Element) -> bool:
+        if element._wire is not None:
+            return element._wire == bytes(RISTRETTO_BYTES)
         return edwards.pt_is_identity(element.point)
 
     @staticmethod
     def validate_element(element: Element) -> None:
         """Recompression validation (ristretto.rs:173-185): identity is valid;
-        otherwise encode→decode must round-trip to the same coset."""
-        if edwards.pt_is_identity(element.point):
+        otherwise encode→decode must round-trip to the same coset.  Uses the
+        C++ core's decode+encode when available (same canonical rules,
+        enforced bit-exact by tests/test_native.py)."""
+        if Ristretto255.is_identity(element):
             return
-        compressed = edwards.ristretto_encode(element.point)
+        compressed = element.wire()
+        rt = _native.point_roundtrip(compressed)
+        if rt is not None:
+            if rt != compressed:
+                raise InvalidGroupElement("Element failed recompression validation")
+            return
         point = edwards.ristretto_decode(compressed)
         if point is None or not edwards.pt_eq(point, element.point):
             raise InvalidGroupElement("Element failed recompression validation")

@@ -117,6 +117,9 @@ class CpuBackend(VerifierBackend):
         return edwards.pt_eq(lhs, acc)
 
     def verify_each(self, rows: list[BatchRow]) -> list[bool]:
+        native = self._verify_each_native(rows)
+        if native is not None:
+            return native
         out = []
         for row in rows:
             lhs1 = edwards.pt_scalar_mul(row.g.point, row.s.value)
@@ -125,6 +128,30 @@ class CpuBackend(VerifierBackend):
             rhs2 = edwards.pt_add(row.r2.point, edwards.pt_scalar_mul(row.y2.point, row.c.value))
             out.append(edwards.pt_eq(lhs1, rhs1) and edwards.pt_eq(lhs2, rhs2))
         return out
+
+    @staticmethod
+    def _verify_each_native(rows: list[BatchRow]) -> list[bool] | None:
+        """Threaded C++ row verification (native/ristretto.cpp) when the
+        library is loadable and the batch shares one generator pair; None
+        routes the caller to the pure-Python oracle."""
+        if not rows:
+            return []
+        if not all(r.g == rows[0].g and r.h == rows[0].h for r in rows):
+            return None
+        from ..core import _native
+
+        eb = Ristretto255.element_to_bytes
+        sb = Ristretto255.scalar_to_bytes
+        return _native.verify_rows(
+            eb(rows[0].g),
+            eb(rows[0].h),
+            b"".join(eb(r.y1) for r in rows),
+            b"".join(eb(r.y2) for r in rows),
+            b"".join(eb(r.r1) for r in rows),
+            b"".join(eb(r.r2) for r in rows),
+            b"".join(sb(r.s) for r in rows),
+            b"".join(sb(r.c) for r in rows),
+        )
 
 
 class FailoverBackend(VerifierBackend):

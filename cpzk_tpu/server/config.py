@@ -105,11 +105,12 @@ class ServerConfig:
             self.host = v
         if (v := get("PORT")) is not None:
             self.port = int(v)
-        if (v := get("RATE_LIMIT_REQUESTS_PER_MINUTE")) is not None:
+        # short aliases mirror the reference's clap env names
+        if (v := get("RATE_LIMIT_REQUESTS_PER_MINUTE") or get("RATE_LIMIT")) is not None:
             self.rate_limit.requests_per_minute = int(v)
-        if (v := get("RATE_LIMIT_BURST")) is not None:
+        if (v := get("RATE_LIMIT_BURST") or get("RATE_BURST")) is not None:
             self.rate_limit.burst = int(v)
-        if (v := get("METRICS_ENABLED")) is not None:
+        if (v := get("METRICS_ENABLED") or get("METRICS")) is not None:
             self.metrics.enabled = v.lower() in ("1", "true", "yes", "on")
         if (v := get("METRICS_HOST")) is not None:
             self.metrics.host = v

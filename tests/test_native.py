@@ -75,3 +75,125 @@ def test_strobe_rate_boundary():
     s1.ad(b"a" * 400, False)
     s2.ad(b"a" * 400, False)
     assert s1.prf(200, False) == s2.prf(200, False)
+
+
+# --- C++ ristretto255 verification core (native/ristretto.cpp) -------------
+
+
+def _skip_without_ristretto():
+    from cpzk_tpu.core import _native
+
+    lib = _native.load()
+    if lib is None or not hasattr(lib, "cpzk_verify_rows"):
+        import pytest
+
+        pytest.skip("native ristretto core unavailable")
+
+
+def test_native_point_roundtrip_differential():
+    _skip_without_ristretto()
+    import secrets
+
+    from cpzk_tpu.core import _native, edwards as he, scalars as hs
+
+    for _ in range(24):
+        wire = he.ristretto_encode(
+            he.pt_scalar_mul(he.BASEPOINT, secrets.randbelow(hs.L))
+        )
+        assert _native.point_roundtrip(wire) == wire
+    # canonical-decode rejections: odd s, non-canonical, garbage
+    assert _native.point_roundtrip((3).to_bytes(32, "little")) == b""
+    assert _native.point_roundtrip(((he.P + 1) % 2**256).to_bytes(32, "little")) == b""
+    assert _native.point_roundtrip(b"\xff" * 32) == b""
+    # valid control
+    assert _native.point_roundtrip(he.ristretto_encode(he.BASEPOINT)) != b""
+
+
+def test_native_group_ops_differential():
+    _skip_without_ristretto()
+    import secrets
+
+    from cpzk_tpu.core import _native, edwards as he, scalars as hs
+
+    for _ in range(10):
+        k, m = secrets.randbelow(hs.L), secrets.randbelow(hs.L)
+        P = he.pt_scalar_mul(he.BASEPOINT, k)
+        Q = he.pt_scalar_mul(he.BASEPOINT, m)
+        wp, wq = he.ristretto_encode(P), he.ristretto_encode(Q)
+        assert _native.scalarmul(wp, m.to_bytes(32, "little")) == he.ristretto_encode(
+            he.pt_scalar_mul(P, m)
+        )
+        assert _native.point_add(wp, wq) == he.ristretto_encode(he.pt_add(P, Q))
+    # edge scalars
+    P = he.pt_scalar_mul(he.BASEPOINT, 7)
+    wp = he.ristretto_encode(P)
+    assert _native.scalarmul(wp, (0).to_bytes(32, "little")) == he.ristretto_encode(
+        he.IDENTITY
+    )
+    assert _native.scalarmul(wp, (1).to_bytes(32, "little")) == wp
+
+
+def test_native_verify_rows_differential():
+    _skip_without_ristretto()
+    from cpzk_tpu import Parameters, Prover, SecureRng, Transcript, Witness
+    from cpzk_tpu.core import _native
+    from cpzk_tpu.core.ristretto import Ristretto255
+
+    rng = SecureRng()
+    params = Parameters.new()
+    eb = Ristretto255.element_to_bytes
+    rows = []
+    for _ in range(6):
+        pr = Prover(params, Witness(Ristretto255.random_scalar(rng)))
+        proof = pr.prove_with_transcript(rng, Transcript())
+        t = Transcript()
+        t.append_parameters(eb(params.generator_g), eb(params.generator_h))
+        t.append_statement(eb(pr.statement.y1), eb(pr.statement.y2))
+        t.append_commitment(eb(proof.commitment.r1), eb(proof.commitment.r2))
+        rows.append((pr.statement, proof, t.challenge_scalar()))
+
+    cols = [
+        b"".join(eb(st.y1) for st, _, _ in rows),
+        b"".join(eb(st.y2) for st, _, _ in rows),
+        b"".join(eb(p.commitment.r1) for _, p, _ in rows),
+        b"".join(eb(p.commitment.r2) for _, p, _ in rows),
+        b"".join(Ristretto255.scalar_to_bytes(p.response.s) for _, p, _ in rows),
+        b"".join(Ristretto255.scalar_to_bytes(c) for _, _, c in rows),
+    ]
+    g, h = eb(params.generator_g), eb(params.generator_h)
+    assert _native.verify_rows(g, h, *cols) == [True] * 6
+
+    # corrupted challenge -> that row only fails
+    bad = cols[5][:32] + bytes(32) + cols[5][64:]
+    assert _native.verify_rows(g, h, *cols[:5], bad) == [True, False] + [True] * 4
+
+    # swapped statements -> both swapped rows fail
+    y1_sw = cols[0][32:64] + cols[0][:32] + cols[0][64:]
+    res = _native.verify_rows(g, h, y1_sw, *cols[1:])
+    assert res[0] is False and res[1] is False and res[2:] == [True] * 4
+
+    # invalid point encoding in a row -> clean False, no crash
+    y1_bad = b"\xff" * 32 + cols[0][32:]
+    res = _native.verify_rows(g, h, y1_bad, *cols[1:])
+    assert res[0] is False and res[1:] == [True] * 5
+
+
+def test_cpu_backend_uses_native_rows():
+    """BatchVerifier on the CpuBackend and the pure-Python oracle agree
+    through the native fast path (mixed valid/invalid)."""
+    from cpzk_tpu import BatchVerifier, Parameters, Prover, SecureRng, Transcript, Witness
+    from cpzk_tpu.core.ristretto import Ristretto255
+
+    rng = SecureRng()
+    params = Parameters.new()
+    proofs = []
+    for _ in range(5):
+        pr = Prover(params, Witness(Ristretto255.random_scalar(rng)))
+        proofs.append((pr.statement, pr.prove_with_transcript(rng, Transcript())))
+
+    bv = BatchVerifier()
+    for st, p in proofs:
+        bv.add(params, st, p)
+    bv.add(params, proofs[0][0], proofs[1][1])  # mismatched row
+    res = bv.verify(rng)
+    assert [r is None for r in res] == [True] * 5 + [False]
