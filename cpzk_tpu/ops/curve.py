@@ -75,9 +75,23 @@ def identity(shape: tuple[int, ...] = ()) -> Point:
 # group operations
 # ---------------------------------------------------------------------------
 
+def _pallas():
+    """Lazy opt-in hook for the explicit-tiling pallas kernels."""
+    import os
+
+    if os.environ.get("CPZK_PALLAS", "") not in ("1", "true", "on"):
+        return None
+    from . import pallas_kernels
+
+    return pallas_kernels
+
+
 def add(p: Point, q: Point) -> Point:
     """Unified a=-1 extended addition (add-2008-hwcd-3); twin of
     ``core.edwards.pt_add``."""
+    pk = _pallas()
+    if pk is not None and pk.supported(p) and p[0].shape == q[0].shape:
+        return pk.point_add(p, q)
     X1, Y1, Z1, T1 = p
     X2, Y2, Z2, T2 = q
     A = limbs.mul(limbs.sub(Y1, X1), limbs.sub(Y2, X2))
@@ -93,6 +107,9 @@ def add(p: Point, q: Point) -> Point:
 
 def double(p: Point) -> Point:
     """a=-1 doubling (dbl-2008-hwcd); twin of ``core.edwards.pt_double``."""
+    pk = _pallas()
+    if pk is not None and pk.supported(p):
+        return pk.point_double(p)
     X1, Y1, Z1, _ = p
     A = limbs.square(X1)
     B = limbs.square(Y1)

@@ -1,0 +1,129 @@
+"""Pallas TPU kernels for the hot point operations (experimental, opt-in).
+
+The XLA path in :mod:`cpzk_tpu.ops.curve` already fuses well, but it leaves
+scheduling to the compiler.  These kernels pin the choices explicitly: one
+VMEM-resident block of ``[20, BLOCK]`` limb-major coordinates per grid step,
+with every field multiplication's intermediates (outer product, anti-
+diagonal fold, carry rounds) staying on-chip — no HBM round-trips between
+the 8 muls of a point add.  The in-kernel field math *reuses*
+:mod:`cpzk_tpu.ops.limbs` directly: pallas traces the same jnp ops into
+Mosaic, so the arithmetic cannot drift from the tested XLA twin.
+
+Enable with ``CPZK_PALLAS=1`` (see :func:`enabled`); off-TPU backends run
+the kernels in interpret mode, which the differential tests use.  This is
+the explicit-tiling experiment VERDICT r1 asked for under component #3; the
+XLA path remains the default until the Mosaic lowering is validated on real
+hardware.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from . import limbs
+from .limbs import NLIMBS
+
+BLOCK = int(os.environ.get("CPZK_PALLAS_BLOCK", "512"))
+
+Point = tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]
+
+
+def enabled() -> bool:
+    return os.environ.get("CPZK_PALLAS", "") in ("1", "true", "on")
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _add_kernel(x1, y1, z1, t1, x2, y2, z2, t2, d2, ox, oy, oz, ot):
+    """Unified a=-1 extended addition on one [20, BLOCK] block.
+
+    ``d2`` carries the 2d curve constant as a [20, 1] input block (pallas
+    forbids captured constants)."""
+    X1, Y1, Z1, T1 = x1[...], y1[...], z1[...], t1[...]
+    X2, Y2, Z2, T2 = x2[...], y2[...], z2[...], t2[...]
+    A = limbs.mul(limbs.sub(Y1, X1), limbs.sub(Y2, X2))
+    B = limbs.mul(limbs.add(Y1, X1), limbs.add(Y2, X2))
+    C = limbs.mul(limbs.mul(T1, d2[...]), T2)
+    Dv = limbs.mul_small(limbs.mul(Z1, Z2), 2)
+    E = limbs.sub(B, A)
+    F = limbs.sub(Dv, C)
+    G = limbs.add(Dv, C)
+    H = limbs.add(B, A)
+    ox[...] = limbs.mul(E, F)
+    oy[...] = limbs.mul(G, H)
+    oz[...] = limbs.mul(F, G)
+    ot[...] = limbs.mul(E, H)
+
+
+def _double_kernel(x1, y1, z1, ox, oy, oz, ot):
+    """a=-1 doubling on one [20, BLOCK] block."""
+    X1, Y1, Z1 = x1[...], y1[...], z1[...]
+    A = limbs.square(X1)
+    B = limbs.square(Y1)
+    C = limbs.mul_small(limbs.square(Z1), 2)
+    H = limbs.add(A, B)
+    E = limbs.sub(H, limbs.square(limbs.add(X1, Y1)))
+    G = limbs.sub(A, B)
+    F = limbs.add(C, G)
+    ox[...] = limbs.mul(E, F)
+    oy[...] = limbs.mul(G, H)
+    oz[...] = limbs.mul(F, G)
+    ot[...] = limbs.mul(E, H)
+
+
+@functools.cache
+def _add_call(n: int, block: int, interpret: bool):
+    spec = pl.BlockSpec((NLIMBS, block), lambda i: (0, i))
+    const = pl.BlockSpec((NLIMBS, 1), lambda i: (0, 0))
+    out = jax.ShapeDtypeStruct((NLIMBS, n), jnp.int32)
+    return pl.pallas_call(
+        _add_kernel,
+        grid=(n // block,),
+        in_specs=[spec] * 8 + [const],
+        out_specs=[spec] * 4,
+        out_shape=[out] * 4,
+        interpret=interpret,
+    )
+
+
+@functools.cache
+def _double_call(n: int, block: int, interpret: bool):
+    spec = pl.BlockSpec((NLIMBS, block), lambda i: (0, i))
+    out = jax.ShapeDtypeStruct((NLIMBS, n), jnp.int32)
+    return pl.pallas_call(
+        _double_kernel,
+        grid=(n // block,),
+        in_specs=[spec] * 3,
+        out_specs=[spec] * 4,
+        out_shape=[out] * 4,
+        interpret=interpret,
+    )
+
+
+def supported(p: Point) -> bool:
+    """Pallas path handles 2-D [20, n] coords with block-divisible n."""
+    c = p[0]
+    n = c.shape[-1]
+    block = min(BLOCK, n)
+    return c.ndim == 2 and c.shape[0] == NLIMBS and n % block == 0 and n >= 128
+
+
+def point_add(p: Point, q: Point) -> Point:
+    n = p[0].shape[-1]
+    block = min(BLOCK, n)
+    fn = _add_call(n, block, _interpret())
+    return tuple(fn(*p, *q, limbs.D2))
+
+
+def point_double(p: Point) -> Point:
+    n = p[0].shape[-1]
+    block = min(BLOCK, n)
+    fn = _double_call(n, block, _interpret())
+    return tuple(fn(p[0], p[1], p[2]))
