@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import os
 import time
 
 from ..core.rng import SecureRng
@@ -141,4 +142,14 @@ class DynamicBatcher:
     def _verify(self, entries: list[BatchEntry]) -> list[Error | None]:
         bv = BatchVerifier(backend=self.backend, max_size=max(len(entries), 1))
         bv.entries.extend(entries)  # already validated at RPC ingress
+        xprof = os.environ.get("CPZK_XPROF_DIR")
+        if xprof:
+            # JAX profiler (xprof) trace around the device dispatch —
+            # SURVEY.md §5 tracing/profiling TPU addition; inspect with
+            # tensorboard --logdir $CPZK_XPROF_DIR
+            import jax
+
+            with jax.profiler.trace(xprof):
+                with jax.profiler.TraceAnnotation("cpzk_batch_verify"):
+                    return bv.verify(self._rng)
         return bv.verify(self._rng)
