@@ -250,6 +250,25 @@ def _run_guarded(kernel: str) -> float | None:
         return None
 
 
+def _device_probe() -> bool:
+    """One tiny device computation in a guarded subprocess: if the TPU
+    tunnel is wedged, device *init* hangs forever — better to burn 4
+    minutes probing than a full guard window per kernel."""
+    code = (
+        "import jax, jax.numpy as jnp;"
+        "(jnp.zeros((8,)) + 1).block_until_ready();"
+        "print('ok')"
+    )
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", code],
+            env=dict(os.environ), capture_output=True, text=True, timeout=240,
+        )
+        return proc.returncode == 0
+    except subprocess.TimeoutExpired:
+        return False
+
+
 def main() -> None:
     # CPZK_BENCH_PLATFORM=cpu forces the CPU backend for local smoke runs;
     # env vars alone don't reach jax's config (the axon sitecustomize
@@ -261,6 +280,11 @@ def main() -> None:
         jax.config.update("jax_platforms", plat)
 
     if KERNEL == "auto":
+        if not plat and not _device_probe():
+            print("device probe failed (wedged accelerator tunnel?); retrying once",
+                  file=sys.stderr)
+            if not _device_probe():
+                raise SystemExit("device unreachable: refusing to hang the bench")
         # sequential guarded subprocesses: no device contention, and a hung
         # native compile in one kernel cannot lose the other's number
         results = {
