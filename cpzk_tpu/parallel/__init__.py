@@ -7,6 +7,7 @@ and the combined-check reduction rides ICI collectives (``psum`` under
 ``shard_map``), never DCN, matching the scaling-book recipe.
 """
 
+from . import multihost
 from .mesh import (
     batch_mesh,
     make_sharded_combined_check,
@@ -18,6 +19,7 @@ from .mesh import (
 )
 
 __all__ = [
+    "multihost",
     "batch_mesh",
     "make_sharded_combined_check",
     "make_sharded_msm_check",

@@ -1,0 +1,80 @@
+"""Multi-host scale-out: ``jax.distributed`` + a global batch mesh.
+
+The reference is a single-process program; its only scaling axis is batch
+size (SURVEY.md §2.3).  This module is the TPU-native multi-host analog of
+an NCCL/MPI world: every host runs the same program, ``initialize`` wires
+the jax.distributed coordinator (DCN), and ``global_batch_mesh`` returns a
+1-D mesh over ALL devices in the job — per-chip partial reductions ride ICI
+within a host/pod slice, and only the tiny per-device partial points cross
+DCN during the final combine (see :mod:`cpzk_tpu.parallel.mesh`).
+
+Typical deployment (one process per host):
+
+    from cpzk_tpu.parallel import multihost
+    multihost.initialize(coordinator="10.0.0.1:8476",
+                         num_processes=4, process_id=HOST_INDEX)
+    mesh = multihost.global_batch_mesh()
+    backend = TpuBackend()            # sees the global device set
+    ...
+
+Single-process jobs may call these unconditionally: ``initialize`` is a
+no-op when num_processes == 1, so the same binary runs laptop -> pod.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+import jax
+
+from .mesh import batch_mesh
+
+log = logging.getLogger("cpzk_tpu.parallel.multihost")
+
+_initialized = False
+
+
+def initialize(
+    coordinator: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> None:
+    """Join (or trivially form) the distributed job.
+
+    Arguments default from the standard env vars
+    (``CPZK_COORDINATOR`` / ``CPZK_NUM_PROCESSES`` / ``CPZK_PROCESS_ID``,
+    falling back to jax's own auto-detection on managed TPU pods).
+    No-op for single-process jobs and on repeat calls.
+    """
+    global _initialized
+    if _initialized:
+        return
+    coordinator = coordinator or os.environ.get("CPZK_COORDINATOR")
+    if num_processes is None:
+        num_processes = int(os.environ.get("CPZK_NUM_PROCESSES", "1"))
+    if process_id is None:
+        process_id = int(os.environ.get("CPZK_PROCESS_ID", "0"))
+    if num_processes <= 1 and coordinator is None:
+        _initialized = True
+        return
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    _initialized = True
+    log.info(
+        "joined distributed job: process %d/%d, %d global devices",
+        process_id, num_processes, jax.device_count(),
+    )
+
+
+def global_batch_mesh():
+    """1-D batch mesh over every device in the (possibly multi-host) job."""
+    return batch_mesh(jax.devices())
+
+
+def process_info() -> tuple[int, int]:
+    """(process_index, process_count) of this host in the job."""
+    return jax.process_index(), jax.process_count()

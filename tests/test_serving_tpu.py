@@ -301,3 +301,17 @@ def test_grpc_tpu_backend_end_to_end():
             await server.stop(None)
 
     run(main())
+
+
+def test_multihost_single_process_noop():
+    """multihost.initialize is a no-op for single-process jobs, and the
+    global mesh covers all (virtual) devices."""
+    from cpzk_tpu.parallel import multihost
+
+    multihost.initialize()
+    idx, count = multihost.process_info()
+    assert (idx, count) == (0, 1)
+    mesh = multihost.global_batch_mesh()
+    import jax
+
+    assert mesh.devices.size == len(jax.devices())
