@@ -21,6 +21,7 @@ matches the reference bit-for-bit (SURVEY.md §3.2).
 
 from __future__ import annotations
 
+import os
 from functools import partial
 
 import numpy as np
@@ -37,7 +38,8 @@ from . import curve, msm, verify
 #: Row count at or above which the combined check uses the Pippenger MSM
 #: instead of per-row windowed chains (crossover from the cost model in
 #: ``msm.pick_window``; below this the per-row kernel's 570 ops/row win).
-PIPPENGER_MIN_ROWS = 32
+#: Env-tunable (CPZK_PIPPENGER_MIN) for on-hardware crossover tuning.
+PIPPENGER_MIN_ROWS = int(os.environ.get("CPZK_PIPPENGER_MIN", "32"))
 
 
 def _pad_pow2(n: int) -> int:

@@ -40,24 +40,37 @@ def initialize(
     num_processes: int | None = None,
     process_id: int | None = None,
 ) -> None:
-    """Join (or trivially form) the distributed job.
+    """Join the distributed job; no-op for single-process development.
 
-    Arguments default from the standard env vars
-    (``CPZK_COORDINATOR`` / ``CPZK_NUM_PROCESSES`` / ``CPZK_PROCESS_ID``,
-    falling back to jax's own auto-detection on managed TPU pods).
-    No-op for single-process jobs and on repeat calls.
+    Arguments default from the env vars ``CPZK_COORDINATOR`` /
+    ``CPZK_NUM_PROCESSES`` / ``CPZK_PROCESS_ID``.  Multi-host mode engages
+    when ANY of those (or ``CPZK_MULTIHOST=1``) is present — values left
+    ``None`` are passed through to ``jax.distributed.initialize`` so its
+    own auto-detection fills them in on managed TPU pods.  With no
+    configuration at all this is a no-op (dev/single-host default).
+    Repeat calls after a real join are rejected loudly.
     """
     global _initialized
-    if _initialized:
-        return
     coordinator = coordinator or os.environ.get("CPZK_COORDINATOR")
-    if num_processes is None:
-        num_processes = int(os.environ.get("CPZK_NUM_PROCESSES", "1"))
-    if process_id is None:
-        process_id = int(os.environ.get("CPZK_PROCESS_ID", "0"))
-    if num_processes <= 1 and coordinator is None:
-        _initialized = True
+    if num_processes is None and (v := os.environ.get("CPZK_NUM_PROCESSES")):
+        num_processes = int(v)
+    if process_id is None and (v := os.environ.get("CPZK_PROCESS_ID")):
+        process_id = int(v)
+    explicit = (
+        coordinator is not None
+        or num_processes is not None
+        or process_id is not None
+        or os.environ.get("CPZK_MULTIHOST", "") in ("1", "true", "on")
+    )
+    if _initialized:
+        if explicit:
+            raise RuntimeError(
+                "multihost.initialize called again after the job was formed; "
+                "configure the coordinator once, before any device use"
+            )
         return
+    if not explicit:
+        return  # single-process development: nothing to form, not latched
     jax.distributed.initialize(
         coordinator_address=coordinator,
         num_processes=num_processes,
@@ -66,7 +79,7 @@ def initialize(
     _initialized = True
     log.info(
         "joined distributed job: process %d/%d, %d global devices",
-        process_id, num_processes, jax.device_count(),
+        jax.process_index(), jax.process_count(), jax.device_count(),
     )
 
 
