@@ -36,19 +36,8 @@ def _build(force: bool = False) -> bool:
         return False
 
 
-def load() -> ctypes.CDLL | None:
-    """The native library, or None when unavailable."""
-    global _lib, _tried
-    if _lib is not None or _tried:
-        return _lib
-    _tried = True
-    if not os.path.exists(_LIB_PATH) and not _build():
-        return None
-    try:
-        lib = ctypes.CDLL(_LIB_PATH)
-    except OSError:
-        return None
-
+def _declare(lib: ctypes.CDLL) -> None:
+    """Attach restype/argtypes for every symbol the library exports."""
     lib.cpzk_transcript_new.restype = ctypes.c_void_p
     lib.cpzk_transcript_new.argtypes = [ctypes.c_char_p, ctypes.c_size_t]
     lib.cpzk_transcript_free.argtypes = [ctypes.c_void_p]
@@ -66,35 +55,6 @@ def load() -> ctypes.CDLL | None:
         ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p,
         ctypes.c_int,
     ]
-
-    # ristretto verification core: force-rebuild once if the .so predates
-    # it, but never discard a working (older, merlin-only) library — a
-    # failed rebuild keeps the old file and the old capabilities
-    if not hasattr(lib, "cpzk_verify_rows") and _build(force=True):
-        try:
-            relib = ctypes.CDLL(_LIB_PATH)
-        except OSError:
-            relib = None
-        if relib is not None and hasattr(relib, "cpzk_verify_rows"):
-            lib = relib
-            lib.cpzk_transcript_new.restype = ctypes.c_void_p
-            lib.cpzk_transcript_new.argtypes = [ctypes.c_char_p, ctypes.c_size_t]
-            lib.cpzk_transcript_free.argtypes = [ctypes.c_void_p]
-            lib.cpzk_transcript_append.argtypes = [
-                ctypes.c_void_p, ctypes.c_char_p, ctypes.c_size_t,
-                ctypes.c_char_p, ctypes.c_size_t,
-            ]
-            lib.cpzk_transcript_challenge.argtypes = [
-                ctypes.c_void_p, ctypes.c_char_p, ctypes.c_size_t,
-                ctypes.c_char_p, ctypes.c_size_t,
-            ]
-            lib.cpzk_challenge_batch.argtypes = [
-                ctypes.c_size_t, ctypes.c_char_p, ctypes.POINTER(ctypes.c_uint32),
-                ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p,
-                ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p,
-                ctypes.c_int,
-            ]
-
     if hasattr(lib, "cpzk_verify_rows"):
         lib.cpzk_verify_rows.restype = ctypes.c_int
         lib.cpzk_verify_rows.argtypes = [
@@ -112,6 +72,41 @@ def load() -> ctypes.CDLL | None:
         lib.cpzk_point_add.argtypes = [
             ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p,
         ]
+    if hasattr(lib, "cpzk_double_basemul"):
+        lib.cpzk_basemul_init.restype = ctypes.c_int
+        lib.cpzk_basemul_init.argtypes = [ctypes.c_char_p, ctypes.c_char_p]
+        lib.cpzk_double_basemul.restype = ctypes.c_int
+        lib.cpzk_double_basemul.argtypes = [
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p,
+            ctypes.c_char_p, ctypes.c_char_p,
+        ]
+
+
+def load() -> ctypes.CDLL | None:
+    """The native library, or None when unavailable."""
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    _tried = True
+    if not os.path.exists(_LIB_PATH) and not _build():
+        return None
+    try:
+        lib = ctypes.CDLL(_LIB_PATH)
+    except OSError:
+        return None
+
+    # Force-rebuild once if the .so predates the newest symbols, but never
+    # discard a working (older) library — a failed rebuild keeps the old
+    # file and the old capabilities.
+    if not hasattr(lib, "cpzk_double_basemul") and _build(force=True):
+        try:
+            relib = ctypes.CDLL(_LIB_PATH)
+        except OSError:
+            relib = None
+        if relib is not None and hasattr(relib, "cpzk_double_basemul"):
+            lib = relib
+
+    _declare(lib)
     _lib = lib
     return _lib
 
@@ -181,6 +176,8 @@ def verify_rows(
         return None
     if len(g) != 32 or len(h) != 32:
         raise ValueError("g and h must be 32-byte encodings")
+    if len(ss) % 32 != 0:
+        raise ValueError(f"ss must be a multiple of 32 bytes, got {len(ss)}")
     n = len(ss) // 32
     for name, col in (("y1s", y1s), ("y2s", y2s), ("r1s", r1s),
                       ("r2s", r2s), ("ss", ss), ("cs", cs)):
@@ -215,6 +212,25 @@ def scalarmul(point: bytes, scalar: bytes) -> bytes | None:
     if not lib.cpzk_scalarmul(point, scalar, out):
         return b""
     return out.raw
+
+
+def double_basemul(g: bytes, h: bytes, scalar: bytes) -> tuple[bytes, bytes] | None:
+    """Constant-time (s*G, s*H) via the native fixed-base comb; None when
+    the library (or the symbol) is unavailable, a generator fails to
+    decode, or concurrent callers churn the table's generator pair (rare;
+    the caller then uses its fallback path).  Table (re)builds and reads
+    are serialized by a rwlock on the C side — ctypes releases the GIL
+    around foreign calls, so the GIL alone would not be enough."""
+    lib = _ristretto_lib()
+    if lib is None or not hasattr(lib, "cpzk_double_basemul"):
+        return None
+    if len(g) != 32 or len(h) != 32 or len(scalar) != 32:
+        raise ValueError("g, h and scalar must be 32 bytes")
+    out1 = ctypes.create_string_buffer(32)
+    out2 = ctypes.create_string_buffer(32)
+    if not lib.cpzk_double_basemul(g, h, scalar, out1, out2):
+        return None
+    return out1.raw, out2.raw
 
 
 def point_add(a: bytes, b: bytes) -> bytes | None:

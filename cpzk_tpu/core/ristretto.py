@@ -148,15 +148,40 @@ class Ristretto255:
 
     @staticmethod
     def scalar_mul(element: Element, scalar: Scalar) -> Element:
-        """scalar * element, through the C++ host core when available
-        (bit-exact vs the Python path per tests/test_native.py).  Both
-        paths are variable-time — see docs/security.md."""
+        """scalar * element for PUBLIC inputs, through the C++ host core
+        when available (bit-exact vs the Python path per
+        tests/test_native.py).  Both paths are variable-time — callers
+        with SECRET scalars (prover nonce, witness) must use
+        :meth:`double_base_mul`, which runs the native constant-time
+        fixed-base comb — see docs/security.md."""
         if scalar.value == 0:
             return Ristretto255.identity()
         out = _native.scalarmul(element.wire(), scalars.sc_to_bytes(scalar.value))
         if out:  # None = no library; b"" = decode failure (fall through)
             return Element(wire=out)
         return Element(edwards.pt_scalar_mul(element.point, scalar.value))
+
+    @staticmethod
+    def double_base_mul(g: Element, h: Element, scalar: Scalar) -> tuple[Element, Element]:
+        """(scalar*g, scalar*h) for SECRET scalars — the prover's nonce
+        commitment (prover/mod.rs:115-121) and the statement derivation
+        (gadgets.rs:217-221) are the only places the protocol multiplies a
+        secret.  Uses the native constant-time fixed-base comb (signed
+        radix-16, masked table scan, no secret-dependent branches); falls
+        back to the pure-Python ladder when the native core is absent —
+        Python big-int timing is best-effort, disclosed in
+        docs/security.md."""
+        if scalar.value == 0:
+            return Ristretto255.identity(), Ristretto255.identity()
+        out = _native.double_basemul(
+            g.wire(), h.wire(), scalars.sc_to_bytes(scalar.value)
+        )
+        if out is not None:
+            return Element(wire=out[0]), Element(wire=out[1])
+        return (
+            Element(edwards.pt_scalar_mul(g.point, scalar.value)),
+            Element(edwards.pt_scalar_mul(h.point, scalar.value)),
+        )
 
     @staticmethod
     def element_mul(a: Element, b: Element) -> Element:

@@ -10,9 +10,13 @@
 // oracle is enforced by tests/test_native.py differential tests.
 //
 // Verification inputs are PUBLIC (statements, commitments, challenges,
-// responses), so variable-time table lookups here leak nothing secret
-// (docs/security.md).  This library deliberately contains no secret-key
-// operations.
+// responses), so the variable-time paths (ge_scalarmul, cp_check_eq) leak
+// nothing secret (docs/security.md).  Secret-scalar work — the prover's
+// nonce commitment r1 = k*G, r2 = k*H and the statement derivation
+// y1 = x*G, y2 = x*H — goes through the CONSTANT-TIME fixed-base comb
+// (cpzk_basemul_init / cpzk_double_basemul below): signed radix-16 digits,
+// full-table masked selection, mask-based conditional negation, no
+// secret-dependent branches or memory addressing.
 
 #include <cstdint>
 #include <cstring>
@@ -382,6 +386,150 @@ static void ge_encode(uint8_t *out, const ge &p) {
     fe_tobytes(out, s);
 }
 
+// ---------------------------------------------------------------------------
+// constant-time fixed-base comb for the generators G and H
+// ---------------------------------------------------------------------------
+//
+// Per base: tbl[i][j] = (j+1) * 16^i * B for i in 0..63, j in 0..7.  A
+// canonical scalar (< L < 2^253) recodes to 64 signed radix-16 digits in
+// [-8, 8); the product is a sum of 64 table entries — no doublings at all.
+// Selection scans the full 8-entry window with arithmetic masks; negation
+// is mask-based.  The adds use the same unified formulas as the vartime
+// path (identity-safe), so a zero digit simply adds the masked-in identity.
+
+static void fe_cmov(fe &f, const fe &g, uint64_t mask) {
+    for (int i = 0; i < 5; i++) f.v[i] ^= mask & (f.v[i] ^ g.v[i]);
+}
+
+static void ge_cmov(ge &r, const ge &p, uint64_t mask) {
+    fe_cmov(r.X, p.X, mask);
+    fe_cmov(r.Y, p.Y, mask);
+    fe_cmov(r.Z, p.Z, mask);
+    fe_cmov(r.T, p.T, mask);
+}
+
+// all-ones when a == b (a, b in [0, 255]); branchless
+static uint64_t ct_eq_mask(uint64_t a, uint64_t b) {
+    uint64_t d = a ^ b;
+    return (uint64_t)0 - (((d - 1) & ~d) >> 63);
+}
+
+struct comb_table {
+    ge tbl[64][8];
+    uint8_t wire[32];   // which generator this table is for
+    int ready;
+};
+
+static comb_table COMB_G = {{}, {0}, 0};
+static comb_table COMB_H = {{}, {0}, 0};
+// Guards the global tables: ctypes releases the GIL around foreign calls,
+// so concurrent Python threads CAN race a rebuild against a multiply.
+// Rebuilds take the write lock, multiplies the read lock.
+static pthread_rwlock_t COMB_LOCK = PTHREAD_RWLOCK_INITIALIZER;
+
+static void comb_build(comb_table &t, const ge &base, const uint8_t *wire) {
+    ge cur = base;                       // 16^i * B
+    for (int i = 0; i < 64; i++) {
+        t.tbl[i][0] = cur;
+        for (int j = 1; j < 8; j++) ge_add(t.tbl[i][j], t.tbl[i][j - 1], cur);
+        ge next = t.tbl[i][7];           // 8 * 16^i * B
+        ge_double(next, next);           // 16^(i+1) * B
+        cur = next;
+    }
+    memcpy(t.wire, wire, 32);
+    t.ready = 1;
+}
+
+// signed radix-16 recoding of a canonical (< 2^253) little-endian scalar
+static void recode_radix16(int8_t digits[64], const uint8_t *s) {
+    for (int i = 0; i < 32; i++) {
+        digits[2 * i] = (int8_t)(s[i] & 15);
+        digits[2 * i + 1] = (int8_t)((s[i] >> 4) & 15);
+    }
+    int8_t carry = 0;
+    for (int i = 0; i < 63; i++) {
+        digits[i] = (int8_t)(digits[i] + carry);
+        carry = (int8_t)((digits[i] + 8) >> 4);
+        digits[i] = (int8_t)(digits[i] - (carry << 4));
+    }
+    digits[63] = (int8_t)(digits[63] + carry);  // < 8 since s < 2^253
+}
+
+// constant-time: r = sum_i digits[i] * 16^i * B via masked table scan
+static void comb_mul(ge &r, const comb_table &t, const int8_t digits[64]) {
+    ge_identity(r);
+    for (int i = 0; i < 64; i++) {
+        int8_t d = digits[i];
+        uint64_t neg = (uint64_t)0 - (uint64_t)(((uint8_t)d) >> 7);
+        uint8_t babs = (uint8_t)((d ^ (d >> 7)) - (d >> 7));
+        ge sel;
+        ge_identity(sel);
+        for (int j = 0; j < 8; j++)
+            ge_cmov(sel, t.tbl[i][j], ct_eq_mask(babs, (uint64_t)j + 1));
+        ge nsel;
+        ge_neg(nsel, sel);
+        ge_cmov(sel, nsel, neg);
+        ge s2;
+        ge_add(s2, r, sel);
+        r = s2;
+    }
+}
+
+// tables ready for this generator pair? (caller holds COMB_LOCK)
+static int comb_current(const uint8_t *g_wire, const uint8_t *h_wire) {
+    return COMB_G.ready && COMB_H.ready &&
+           memcmp(COMB_G.wire, g_wire, 32) == 0 &&
+           memcmp(COMB_H.wire, h_wire, 32) == 0;
+}
+
+// Build (or rebuild) the comb tables for the generator pair.  Returns 1 on
+// success, 0 if either encoding fails to decode.  Thread-safe: rebuilds
+// run under the table write lock.
+int cpzk_basemul_init(const uint8_t *g_wire, const uint8_t *h_wire) {
+    pthread_rwlock_rdlock(&COMB_LOCK);
+    int current = comb_current(g_wire, h_wire);
+    pthread_rwlock_unlock(&COMB_LOCK);
+    if (current) return 1;
+    ge G, H;
+    if (!ge_decode(G, g_wire) || !ge_decode(H, h_wire)) return 0;
+    pthread_rwlock_wrlock(&COMB_LOCK);
+    if (!comb_current(g_wire, h_wire)) {
+        comb_build(COMB_G, G, g_wire);
+        comb_build(COMB_H, H, h_wire);
+    }
+    pthread_rwlock_unlock(&COMB_LOCK);
+    return 1;
+}
+
+// out1 = s*G, out2 = s*H (wire bytes), constant time in s.  Builds the
+// tables when missing or built for different generators (one atomic call —
+// no init-then-mul race window); returns 0 only when a generator encoding
+// is invalid.
+int cpzk_double_basemul(const uint8_t *g_wire, const uint8_t *h_wire,
+                        const uint8_t *scalar, uint8_t *out1, uint8_t *out2) {
+    pthread_rwlock_rdlock(&COMB_LOCK);
+    if (!comb_current(g_wire, h_wire)) {
+        pthread_rwlock_unlock(&COMB_LOCK);
+        if (!cpzk_basemul_init(g_wire, h_wire)) return 0;
+        pthread_rwlock_rdlock(&COMB_LOCK);
+        if (!comb_current(g_wire, h_wire)) {
+            // another thread swapped in a different pair between our build
+            // and this read lock; give up rather than loop unboundedly
+            pthread_rwlock_unlock(&COMB_LOCK);
+            return 0;
+        }
+    }
+    int8_t digits[64];
+    recode_radix16(digits, scalar);
+    ge r1, r2;
+    comb_mul(r1, COMB_G, digits);
+    comb_mul(r2, COMB_H, digits);
+    pthread_rwlock_unlock(&COMB_LOCK);
+    ge_encode(out1, r1);
+    ge_encode(out2, r2);
+    return 1;
+}
+
 // variable-base, variable-time scalar mul: 4-bit fixed windows, scalar is
 // 32 canonical little-endian bytes (public verification input)
 static void ge_scalarmul(ge &r, const ge &p, const uint8_t *scalar) {
@@ -409,21 +557,23 @@ static void ge_scalarmul(ge &r, const ge &p, const uint8_t *scalar) {
 // Chaum-Pedersen row verification + threaded batch entry point
 // ---------------------------------------------------------------------------
 
+// 1..15 multiples table for the Straus ladder (slot 0 = identity)
+static void straus_table(ge tb[16], const ge &B) {
+    ge_identity(tb[0]);
+    tb[1] = B;
+    for (int i = 2; i < 16; i++) ge_add(tb[i], tb[i - 1], B);
+}
+
 // one equation: s*B == R + c*Y  <=>  s*B + c*(-Y) - R == identity.
 // Straus shared-doubling: one 255-double ladder with two 4-bit tables
-// (~half the doublings of two independent scalar muls).
-static int cp_check_eq(const ge &B, const ge &Y, const ge &R,
+// (~half the doublings of two independent scalar muls).  The base table
+// ``tb`` ({1..15}*B) is precomputed once per batch — B is the shared
+// generator G or H, so rebuilding it per row would waste 15 adds/row.
+static int cp_check_eq(const ge tb[16], const ge &Y, const ge &R,
                        const uint8_t *s, const uint8_t *c) {
-    ge tb[16], ty[16], nY, acc, nR;
+    ge ty[16], nY, acc, nR;
     ge_neg(nY, Y);
-    ge_identity(tb[0]);
-    ge_identity(ty[0]);
-    tb[1] = B;
-    ty[1] = nY;
-    for (int i = 2; i < 16; i++) {
-        ge_add(tb[i], tb[i - 1], B);
-        ge_add(ty[i], ty[i - 1], nY);
-    }
+    straus_table(ty, nY);
     ge_identity(acc);
     for (int i = 63; i >= 0; i--) {
         int sb = s[i >> 1], cb = c[i >> 1];
@@ -456,7 +606,7 @@ struct row_job {
     size_t n;
     size_t next;           // work index (mutex-guarded)
     pthread_mutex_t lock;
-    ge G, H;
+    ge tbG[16], tbH[16];   // shared Straus tables for the generators
     int gh_ok;
 };
 
@@ -477,8 +627,8 @@ static void *row_worker(void *arg) {
         }
         const uint8_t *s = job->s + 32 * i;
         const uint8_t *c = job->c + 32 * i;
-        job->out[i] = cp_check_eq(job->G, y1, r1, s, c) &&
-                      cp_check_eq(job->H, y2, r2, s, c);
+        job->out[i] = cp_check_eq(job->tbG, y1, r1, s, c) &&
+                      cp_check_eq(job->tbH, y2, r2, s, c);
     }
 }
 
@@ -497,7 +647,12 @@ int cpzk_verify_rows(size_t n, const uint8_t *g, const uint8_t *h,
     job.n = n;
     job.next = 0;
     pthread_mutex_init(&job.lock, nullptr);
-    job.gh_ok = ge_decode(job.G, g) && ge_decode(job.H, h);
+    ge G, H;
+    job.gh_ok = ge_decode(G, g) && ge_decode(H, h);
+    if (job.gh_ok) {
+        straus_table(job.tbG, G);
+        straus_table(job.tbH, H);
+    }
 
     if (n_threads < 1) n_threads = 1;
     if ((size_t)n_threads > n) n_threads = (int)n;

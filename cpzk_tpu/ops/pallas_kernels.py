@@ -28,7 +28,14 @@ from jax.experimental import pallas as pl
 from . import limbs
 from .limbs import NLIMBS
 
-BLOCK = int(os.environ.get("CPZK_PALLAS_BLOCK", "512"))
+# invalid (non-positive / non-numeric) values fall back to the default
+# instead of poisoning every `n % BLOCK` in supported() (ADVICE r2)
+try:
+    BLOCK = int(os.environ.get("CPZK_PALLAS_BLOCK", "512"))
+except ValueError:
+    BLOCK = 512
+if BLOCK < 1:
+    BLOCK = 512
 
 Point = tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]
 

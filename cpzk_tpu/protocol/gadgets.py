@@ -86,10 +86,11 @@ class Statement:
 
     @staticmethod
     def from_witness(params: Parameters, witness: Witness) -> "Statement":
-        return Statement(
-            Ristretto255.scalar_mul(params.generator_g, witness.secret()),
-            Ristretto255.scalar_mul(params.generator_h, witness.secret()),
+        # x is secret: constant-time fixed-base path (ADVICE r2)
+        y1, y2 = Ristretto255.double_base_mul(
+            params.generator_g, params.generator_h, witness.secret()
         )
+        return Statement(y1, y2)
 
     def validate(self) -> None:
         Ristretto255.validate_element(self.y1)

@@ -65,8 +65,10 @@ class Prover:
     def commit(self, rng: SecureRng) -> tuple[Commitment, Nonce]:
         """Interactive first message: k ← rng, r1 = g^k, r2 = h^k (prover/mod.rs:115-121)."""
         k = Ristretto255.random_scalar(rng)
-        r1 = Ristretto255.scalar_mul(self.params.generator_g, k)
-        r2 = Ristretto255.scalar_mul(self.params.generator_h, k)
+        # k is secret: constant-time fixed-base path (ADVICE r2)
+        r1, r2 = Ristretto255.double_base_mul(
+            self.params.generator_g, self.params.generator_h, k
+        )
         return Commitment(r1, r2), Nonce(k)
 
     def respond(self, nonce: Nonce, challenge: Scalar) -> Response:

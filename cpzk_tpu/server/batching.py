@@ -34,6 +34,12 @@ from . import metrics
 log = logging.getLogger("cpzk_tpu.server.batching")
 
 
+class QueueFull(Exception):
+    """Backpressure signal: the batcher queue is at capacity.  The RPC
+    layer maps this to RESOURCE_EXHAUSTED (ADVICE r2: an unbounded queue
+    grows without limit under sustained overload)."""
+
+
 class DynamicBatcher:
     """Deadline-based request coalescing in front of a ``VerifierBackend``."""
 
@@ -42,9 +48,13 @@ class DynamicBatcher:
         backend: VerifierBackend | None,
         max_batch: int = 4096,
         window_ms: float = 5.0,
+        max_queue: int | None = None,
     ):
         self.backend = backend
         self.max_batch = max_batch
+        # shed load once more than a few device batches are waiting; the
+        # dispatcher drains max_batch per pass, so 4x is ~4 windows of grace
+        self.max_queue = max_queue if max_queue is not None else 4 * max_batch
         self.window = window_ms / 1000.0
         self._queue: list[tuple[BatchEntry, asyncio.Future]] = []
         self._wakeup: asyncio.Event = asyncio.Event()
@@ -80,6 +90,11 @@ class DynamicBatcher:
             # shutdown window (stop() ran but the listener is still up) or
             # batcher never started: verify inline with identical semantics
             return (await asyncio.to_thread(self._verify, [entry]))[0]
+        if len(self._queue) >= self.max_queue:
+            metrics.counter("tpu.queue.shed").inc()
+            raise QueueFull(
+                f"verification queue at capacity ({self.max_queue} entries)"
+            )
         fut = asyncio.get_running_loop().create_future()
         self._queue.append((entry, fut))
         metrics.gauge("tpu.queue.depth").set(len(self._queue))

@@ -101,16 +101,27 @@ class ServerConfig:
         def get(name: str) -> str | None:
             return env.get(f"SERVER_{name}")
 
+        def get_alias(primary: str, alias: str) -> str | None:
+            # A set-but-empty primary must win over the alias (ADVICE r2:
+            # `get(a) or get(b)` treats "" as unset and falls through) —
+            # but an empty value means "explicitly unset": it suppresses
+            # the alias AND keeps the default, rather than crashing int()
+            # (deployment templates render optional vars as empty).
+            v = get(primary)
+            if v is None:
+                v = get(alias)
+            return v if v != "" else None
+
         if (v := get("HOST")) is not None:
             self.host = v
         if (v := get("PORT")) is not None:
             self.port = int(v)
         # short aliases mirror the reference's clap env names
-        if (v := get("RATE_LIMIT_REQUESTS_PER_MINUTE") or get("RATE_LIMIT")) is not None:
+        if (v := get_alias("RATE_LIMIT_REQUESTS_PER_MINUTE", "RATE_LIMIT")) is not None:
             self.rate_limit.requests_per_minute = int(v)
-        if (v := get("RATE_LIMIT_BURST") or get("RATE_BURST")) is not None:
+        if (v := get_alias("RATE_LIMIT_BURST", "RATE_BURST")) is not None:
             self.rate_limit.burst = int(v)
-        if (v := get("METRICS_ENABLED") or get("METRICS")) is not None:
+        if (v := get_alias("METRICS_ENABLED", "METRICS")) is not None:
             self.metrics.enabled = v.lower() in ("1", "true", "yes", "on")
         if (v := get("METRICS_HOST")) is not None:
             self.metrics.host = v

@@ -22,7 +22,7 @@ from ..core.transcript import Transcript
 from ..protocol.batch import BatchVerifier, VerifierBackend
 from ..protocol.gadgets import Parameters, Proof, Statement
 from ..protocol.verifier import Verifier
-from . import metrics
+from . import batching, metrics
 from .config import RateLimiter, RateLimitExceeded
 from .proto import SERVICE_NAME, load_pb2, method_types
 from .state import ServerState, UserData
@@ -251,9 +251,15 @@ class AuthServiceImpl:
         if self.batcher is not None:
             # TPU serving path: coalesce with concurrent RPCs into one
             # device batch; per-entry result has identical semantics
-            verify_err = await self.batcher.submit(
-                Parameters.new(), user.statement, proof, bytes(request.challenge_id)
-            )
+            try:
+                verify_err = await self.batcher.submit(
+                    Parameters.new(), user.statement, proof, bytes(request.challenge_id)
+                )
+            except batching.QueueFull:
+                metrics.counter("auth.verify.failure").inc()
+                await context.abort(
+                    grpc.StatusCode.RESOURCE_EXHAUSTED, "Server overloaded"
+                )
         else:
             verifier = Verifier(Parameters.new(), user.statement)
             transcript = Transcript()
@@ -361,18 +367,37 @@ class AuthServiceImpl:
                 if self.batcher is not None:
                     import asyncio
 
-                    batch_results = list(
-                        await asyncio.gather(
-                            *[
-                                self.batcher.submit(
-                                    e.params, e.statement, e.proof, e.transcript_context
-                                )
-                                for e in batch.entries
-                            ]
-                        )
+                    # return_exceptions so one QueueFull doesn't orphan the
+                    # sibling submits that already enqueued — their results
+                    # are awaited (and discarded) before the RPC aborts
+                    gathered = await asyncio.gather(
+                        *[
+                            self.batcher.submit(
+                                e.params, e.statement, e.proof, e.transcript_context
+                            )
+                            for e in batch.entries
+                        ],
+                        return_exceptions=True,
                     )
+                    for r in gathered:
+                        if isinstance(r, BaseException) and not isinstance(
+                            r, (batching.QueueFull, errors.Error)
+                        ):
+                            raise r
+                    if any(isinstance(r, batching.QueueFull) for r in gathered):
+                        raise batching.QueueFull("verification queue at capacity")
+                    # each element is now None (ok) or an errors.Error
+                    # (returned or raised by submit — same meaning)
+                    batch_results = [
+                        r if isinstance(r, errors.Error) else None for r in gathered
+                    ]
                 else:
                     batch_results = batch.verify(self.rng)
+            except batching.QueueFull:
+                metrics.counter("auth.verify_batch.failure").inc()
+                await context.abort(
+                    grpc.StatusCode.RESOURCE_EXHAUSTED, "Server overloaded"
+                )
             except errors.Error as e:
                 metrics.counter("auth.verify_batch.failure").inc()
                 await context.abort(grpc.StatusCode.INTERNAL, f"Batch verification failed: {e}")

@@ -86,3 +86,28 @@ def test_unknown_backend_rejected(clean_env):
     cfg.tpu.backend = "gpu"
     with pytest.raises(ValueError):
         cfg.validate()
+
+
+def test_empty_primary_env_beats_alias(clean_env, monkeypatch):
+    """An explicitly-set empty primary name must not fall through to its
+    short alias (ADVICE r2)."""
+    monkeypatch.setenv("SERVER_METRICS_ENABLED", "")
+    monkeypatch.setenv("SERVER_METRICS", "true")
+    cfg = ServerConfig.from_env()
+    # "" parses as not-enabled; the SERVER_METRICS alias must NOT override
+    assert cfg.metrics.enabled is False
+
+    monkeypatch.setenv("SERVER_RATE_LIMIT_BURST", "7")
+    monkeypatch.setenv("SERVER_RATE_BURST", "99")
+    cfg = ServerConfig.from_env()
+    assert cfg.rate_limit.burst == 7
+
+
+def test_empty_int_env_keeps_default(clean_env, monkeypatch):
+    """Deployment templates render optional vars as "": that must keep the
+    default (and suppress the alias), not crash int("") at startup."""
+    default_burst = ServerConfig.from_env().rate_limit.burst
+    monkeypatch.setenv("SERVER_RATE_LIMIT_BURST", "")
+    monkeypatch.setenv("SERVER_RATE_BURST", "99")
+    cfg = ServerConfig.from_env()
+    assert cfg.rate_limit.burst == default_burst

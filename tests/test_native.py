@@ -197,3 +197,95 @@ def test_cpu_backend_uses_native_rows():
     bv.add(params, proofs[0][0], proofs[1][1])  # mismatched row
     res = bv.verify(rng)
     assert [r is None for r in res] == [True] * 5 + [False]
+
+
+def test_double_basemul_matches_python_oracle():
+    """The constant-time fixed-base comb (cpzk_double_basemul) is bit-exact
+    vs the pure-Python ladder for random and edge-case scalars, for both
+    the standard generator pair and a custom pair."""
+    from cpzk_tpu.core import _native, edwards, scalars
+    from cpzk_tpu.core.ristretto import Ristretto255, Scalar
+    from cpzk_tpu.core.rng import SecureRng
+
+    lib = _native._ristretto_lib()
+    if lib is None or not hasattr(lib, "cpzk_double_basemul"):
+        pytest.skip("native core unavailable")
+
+    rng = SecureRng()
+    g, h = Ristretto255.generator_g(), Ristretto255.generator_h()
+    cases = [Ristretto255.random_scalar(rng).value for _ in range(8)]
+    cases += [0, 1, 15, 16, 17, 255, scalars.L - 1, 2**252 + 27742]
+    for v in cases:
+        r1, r2 = Ristretto255.double_base_mul(g, h, Scalar(v))
+        assert r1.wire() == edwards.ristretto_encode(
+            edwards.pt_scalar_mul(g.point, v % scalars.L)
+        )
+        assert r2.wire() == edwards.ristretto_encode(
+            edwards.pt_scalar_mul(h.point, v % scalars.L)
+        )
+
+    # custom generator pair: tables rebuild for the new pair (and back)
+    x = Ristretto255.random_scalar(rng)
+    g2, h2 = Ristretto255.double_base_mul(g, h, x)  # some other pair
+    s = Ristretto255.random_scalar(rng)
+    a1, a2 = Ristretto255.double_base_mul(g2, h2, s)
+    assert a1.wire() == edwards.ristretto_encode(
+        edwards.pt_scalar_mul(g2.point, s.value)
+    )
+    assert a2.wire() == edwards.ristretto_encode(
+        edwards.pt_scalar_mul(h2.point, s.value)
+    )
+    b1, b2 = Ristretto255.double_base_mul(g, h, s)
+    assert b1.wire() == edwards.ristretto_encode(
+        edwards.pt_scalar_mul(g.point, s.value)
+    )
+    assert b2.wire() == edwards.ristretto_encode(
+        edwards.pt_scalar_mul(h.point, s.value)
+    )
+
+
+def test_verify_rows_rejects_ragged_scalar_column():
+    """len(ss) not a multiple of 32 raises instead of silently truncating
+    (ADVICE r2)."""
+    from cpzk_tpu.core import _native
+
+    if _native._ristretto_lib() is None:
+        pytest.skip("native core unavailable")
+    with pytest.raises(ValueError, match="multiple of 32"):
+        _native.verify_rows(b"\x00" * 32, b"\x00" * 32, b"", b"", b"", b"", b"\x01" * 33, b"")
+
+
+def test_double_basemul_concurrent_generator_churn():
+    """Two threads alternating generator pairs must always get correct
+    points — the C side serializes table rebuilds with a rwlock (ctypes
+    releases the GIL, so the GIL alone is no protection)."""
+    import threading
+
+    from cpzk_tpu.core import edwards, scalars
+    from cpzk_tpu.core.ristretto import Ristretto255, Scalar
+    from cpzk_tpu.core.rng import SecureRng
+
+    rng = SecureRng()
+    g, h = Ristretto255.generator_g(), Ristretto255.generator_h()
+    x = Ristretto255.random_scalar(rng)
+    g2, h2 = Ristretto255.double_base_mul(g, h, x)
+    pairs = [(g, h), (g2, h2)]
+    scalars_ = [Ristretto255.random_scalar(rng) for _ in range(8)]
+    failures: list[str] = []
+
+    def worker(which: int) -> None:
+        for i in range(20):
+            gg, hh = pairs[(which + i) % 2]
+            s = scalars_[i % len(scalars_)]
+            r1, r2 = Ristretto255.double_base_mul(gg, hh, s)
+            e1 = edwards.ristretto_encode(edwards.pt_scalar_mul(gg.point, s.value))
+            e2 = edwards.ristretto_encode(edwards.pt_scalar_mul(hh.point, s.value))
+            if r1.wire() != e1 or r2.wire() != e2:
+                failures.append(f"thread {which} iter {i}")
+
+    threads = [threading.Thread(target=worker, args=(w,)) for w in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not failures, failures

@@ -120,6 +120,35 @@ def test_batcher_respects_max_batch():
     assert sum(batcher.dispatched_sizes) == 5
 
 
+def test_batcher_sheds_load_at_max_queue():
+    """Backpressure (ADVICE r2): submissions beyond max_queue raise
+    QueueFull instead of growing the queue without bound."""
+    from cpzk_tpu.server.batching import QueueFull
+
+    params, proofs = make_proofs(1)
+    st, pr = proofs[0]
+
+    async def main():
+        # never started -> no dispatcher; but a started batcher with a slow
+        # window shows the same behavior, so start it with a long window to
+        # keep entries queued while we overfill
+        batcher = DynamicBatcher(
+            CpuBackend(), max_batch=64, window_ms=5_000.0, max_queue=3
+        )
+        batcher.start()
+        pending = [
+            asyncio.ensure_future(batcher.submit(params, st, pr, None))
+            for _ in range(3)
+        ]
+        await asyncio.sleep(0.05)  # let the 3 land in the queue
+        with pytest.raises(QueueFull):
+            await batcher.submit(params, st, pr, None)
+        await batcher.stop()  # drains the 3 queued entries
+        return await asyncio.gather(*pending)
+
+    assert run(main()) == [None] * 3
+
+
 def test_batcher_drains_on_stop():
     params, proofs = make_proofs(2)
 
