@@ -216,17 +216,16 @@ def bench_rowcombined(inp: _Inputs) -> float:
     return _time_kernel(kernel, (r1, y1, r2, y2, w_a, w_ac, w_ba, w_bac))
 
 
-def _emit(value: float) -> None:
-    print(
-        json.dumps(
-            {
-                "metric": "batch_verify_proofs_per_sec",
-                "value": round(value, 1),
-                "unit": "proofs/s",
-                "vs_baseline": round(value / BASELINE, 3),
-            }
-        )
-    )
+def _emit(value: float, diagnostic: str | None = None) -> None:
+    rec = {
+        "metric": "batch_verify_proofs_per_sec",
+        "value": round(value, 1),
+        "unit": "proofs/s",
+        "vs_baseline": round(value / BASELINE, 3),
+    }
+    if diagnostic:
+        rec["diagnostic"] = diagnostic
+    print(json.dumps(rec))
 
 
 def _run_guarded(kernel: str) -> float | None:
@@ -250,10 +249,10 @@ def _run_guarded(kernel: str) -> float | None:
         return None
 
 
-def _device_probe() -> bool:
+def _device_probe(timeout: int = 240) -> bool:
     """One tiny device computation in a guarded subprocess: if the TPU
-    tunnel is wedged, device *init* hangs forever — better to burn 4
-    minutes probing than a full guard window per kernel."""
+    tunnel is wedged, device *init* hangs forever — better to burn a
+    probe window than a full guard window per kernel."""
     code = (
         "import jax, jax.numpy as jnp;"
         "(jnp.zeros((8,)) + 1).block_until_ready();"
@@ -262,11 +261,36 @@ def _device_probe() -> bool:
     try:
         proc = subprocess.run(
             [sys.executable, "-c", code],
-            env=dict(os.environ), capture_output=True, text=True, timeout=240,
+            env=dict(os.environ), capture_output=True, text=True, timeout=timeout,
         )
         return proc.returncode == 0
     except subprocess.TimeoutExpired:
         return False
+
+
+def _probe_with_backoff() -> bool:
+    """Retry the device probe across several minutes — round-1/2 evidence
+    says tunnel wedges are transient.  Budget: CPZK_BENCH_PROBE_SECS total
+    (default 1800s), probes every ~3 min."""
+    budget = int(os.environ.get("CPZK_BENCH_PROBE_SECS", "1800"))
+    deadline = time.monotonic() + budget
+    attempt = 0
+    while True:
+        attempt += 1
+        if _device_probe():
+            if attempt > 1:
+                print(f"device probe ok after {attempt} attempts", file=sys.stderr)
+            return True
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            return False
+        wait = min(60.0, remaining)
+        print(
+            f"device probe failed (attempt {attempt}); retrying in {wait:.0f}s "
+            f"({remaining:.0f}s of probe budget left)",
+            file=sys.stderr,
+        )
+        time.sleep(wait)
 
 
 def main() -> None:
@@ -280,11 +304,13 @@ def main() -> None:
         jax.config.update("jax_platforms", plat)
 
     if KERNEL == "auto":
-        if not plat and not _device_probe():
-            print("device probe failed (wedged accelerator tunnel?); retrying once",
-                  file=sys.stderr)
-            if not _device_probe():
-                raise SystemExit("device unreachable: refusing to hang the bench")
+        if not plat and not _probe_with_backoff():
+            # VERDICT r2 item 1: still record something machine-readable
+            # (rc=0) so the round has an artifact, with a diagnostic field
+            # instead of a bare failure.
+            _emit(0.0, diagnostic="device unreachable: accelerator tunnel "
+                  "wedged through the whole probe budget")
+            return
         # sequential guarded subprocesses: no device contention, and a hung
         # native compile in one kernel cannot lose the other's number
         results = {
@@ -293,7 +319,10 @@ def main() -> None:
             if (v := _run_guarded(k)) is not None
         }
         if not results:
-            raise SystemExit("no bench kernel produced a result")
+            _emit(0.0, diagnostic="device reachable but no bench kernel "
+                  "finished inside its guard window "
+                  f"({GUARD_SECS}s each; wedge mid-run?)")
+            return
         _emit(max(results.values()))
         return
 
