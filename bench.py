@@ -249,10 +249,12 @@ def _run_guarded(kernel: str) -> float | None:
         return None
 
 
-def _device_probe(timeout: int = 240) -> bool:
+def _device_probe(timeout: int = 240) -> tuple[bool, str]:
     """One tiny device computation in a guarded subprocess: if the TPU
     tunnel is wedged, device *init* hangs forever — better to burn a
-    probe window than a full guard window per kernel."""
+    probe window than a full guard window per kernel.  Returns
+    (ok, failure_reason) so a hang is distinguishable from a
+    deterministic error (broken install, PJRT failure)."""
     code = (
         "import jax, jax.numpy as jnp;"
         "(jnp.zeros((8,)) + 1).block_until_ready();"
@@ -263,31 +265,37 @@ def _device_probe(timeout: int = 240) -> bool:
             [sys.executable, "-c", code],
             env=dict(os.environ), capture_output=True, text=True, timeout=timeout,
         )
-        return proc.returncode == 0
     except subprocess.TimeoutExpired:
-        return False
+        return False, f"probe hung past {timeout}s (wedged tunnel)"
+    if proc.returncode == 0:
+        return True, ""
+    return False, (
+        f"probe exited rc={proc.returncode}: {proc.stderr.strip()[-500:]}"
+    )
 
 
-def _probe_with_backoff() -> bool:
+def _probe_with_backoff() -> tuple[bool, str]:
     """Retry the device probe across several minutes — round-1/2 evidence
     says tunnel wedges are transient.  Budget: CPZK_BENCH_PROBE_SECS total
-    (default 1800s), probes every ~3 min."""
+    (default 1800s).  Returns (ok, last_failure_reason)."""
     budget = int(os.environ.get("CPZK_BENCH_PROBE_SECS", "1800"))
     deadline = time.monotonic() + budget
     attempt = 0
+    reason = ""
     while True:
         attempt += 1
-        if _device_probe():
+        ok, reason = _device_probe()
+        if ok:
             if attempt > 1:
                 print(f"device probe ok after {attempt} attempts", file=sys.stderr)
-            return True
+            return True, ""
         remaining = deadline - time.monotonic()
         if remaining <= 0:
-            return False
+            return False, reason
         wait = min(60.0, remaining)
         print(
-            f"device probe failed (attempt {attempt}); retrying in {wait:.0f}s "
-            f"({remaining:.0f}s of probe budget left)",
+            f"device probe failed (attempt {attempt}: {reason}); retrying in "
+            f"{wait:.0f}s ({remaining:.0f}s of probe budget left)",
             file=sys.stderr,
         )
         time.sleep(wait)
@@ -304,13 +312,16 @@ def main() -> None:
         jax.config.update("jax_platforms", plat)
 
     if KERNEL == "auto":
-        if not plat and not _probe_with_backoff():
-            # VERDICT r2 item 1: still record something machine-readable
-            # (rc=0) so the round has an artifact, with a diagnostic field
-            # instead of a bare failure.
-            _emit(0.0, diagnostic="device unreachable: accelerator tunnel "
-                  "wedged through the whole probe budget")
-            return
+        if not plat:
+            ok, reason = _probe_with_backoff()
+            if not ok:
+                # VERDICT r2 item 1: still record something machine-readable
+                # (rc=0) so the round has an artifact, with a diagnostic
+                # field carrying the actual last failure instead of a bare
+                # nonzero exit.
+                _emit(0.0, diagnostic=f"device unreachable through the "
+                      f"whole probe budget; last failure: {reason}")
+                return
         # sequential guarded subprocesses: no device contention, and a hung
         # native compile in one kernel cannot lose the other's number
         results = {

@@ -208,4 +208,10 @@ class TpuBackend(VerifierBackend):
             mask = self._sharded_each(g, h, y1, y2, r1, r2, ws, wc)
         else:
             mask = _each_shared(pad, g, h, y1, y2, r1, r2, ws, wc)
+        if hasattr(mask, "is_fully_addressable") and not mask.is_fully_addressable:
+            # multi-host job: the [n]-sharded result spans devices owned by
+            # other processes; gather the global value everywhere
+            from jax.experimental import multihost_utils
+
+            mask = multihost_utils.process_allgather(mask, tiled=True)
         return [bool(v) for v in np.asarray(mask)[:n]]

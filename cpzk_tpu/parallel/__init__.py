@@ -5,18 +5,14 @@ scaling axis is proof-batch size. The TPU-native analog shards that batch
 axis across a ``jax.sharding.Mesh`` — per-chip partial work runs locally,
 and the combined-check reduction rides ICI collectives (``psum`` under
 ``shard_map``), never DCN, matching the scaling-book recipe.
+
+Re-exports resolve lazily: importing this package must NOT initialize the
+XLA backend (``ops.limbs`` materializes device constants at import), or
+``jax.distributed.initialize`` — which must run before any backend use —
+could never be called after ``import cpzk_tpu.parallel``.
 """
 
 from . import multihost
-from .mesh import (
-    batch_mesh,
-    make_sharded_combined_check,
-    make_sharded_msm_check,
-    make_sharded_verify_each,
-    sharded_combined_check,
-    sharded_msm_check,
-    sharded_verify_each,
-)
 
 __all__ = [
     "multihost",
@@ -28,3 +24,13 @@ __all__ = [
     "sharded_msm_check",
     "sharded_verify_each",
 ]
+
+_MESH_NAMES = frozenset(__all__) - {"multihost"}
+
+
+def __getattr__(name: str):
+    if name in _MESH_NAMES:
+        from . import mesh
+
+        return getattr(mesh, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -28,8 +28,6 @@ import os
 
 import jax
 
-from .mesh import batch_mesh
-
 log = logging.getLogger("cpzk_tpu.parallel.multihost")
 
 _initialized = False
@@ -84,7 +82,14 @@ def initialize(
 
 
 def global_batch_mesh():
-    """1-D batch mesh over every device in the (possibly multi-host) job."""
+    """1-D batch mesh over every device in the (possibly multi-host) job.
+
+    The mesh module import is deferred: it materializes device constants,
+    which would initialize the backend — and :func:`initialize` must be
+    able to run first.
+    """
+    from .mesh import batch_mesh
+
     return batch_mesh(jax.devices())
 
 
