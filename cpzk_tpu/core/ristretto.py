@@ -133,6 +133,17 @@ class Ristretto255:
     def element_from_bytes(data: bytes) -> Element:
         if len(data) != RISTRETTO_BYTES:
             raise InvalidGroupElement(f"Expected {RISTRETTO_BYTES} bytes, got {len(data)}")
+        # Native fast path: ge_decode applies the same canonical rules as
+        # the Python decoder (tests/test_native.py differential), and a
+        # successful decode re-encodes to the identical bytes, so validity
+        # is exactly "roundtrip returns non-empty".  Coordinates are then
+        # materialized lazily — most wire elements (proof parsing, server
+        # ingress) never need them.
+        rt = _native.point_roundtrip(bytes(data))
+        if rt is not None:
+            if rt == b"":
+                raise InvalidGroupElement("Bytes do not represent a valid Ristretto point")
+            return Element(wire=bytes(data))
         point = edwards.ristretto_decode(data)
         if point is None:
             raise InvalidGroupElement("Bytes do not represent a valid Ristretto point")

@@ -91,6 +91,7 @@ def test_100_user_grpc_batch():
     asyncio.run(main())
 
 
+@pytest.mark.slow
 @pytest.mark.skipif(
     not os.environ.get("CPZK_SLOW_TESTS"),
     reason="64k-row device batch: minutes of XLA compile on CPU; set "

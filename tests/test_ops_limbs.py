@@ -138,3 +138,50 @@ def test_bytes_to_limbs_vectorized(ab):
     a, _, _, _ = ab
     rows = np.stack([np.frombuffer(hf.fe_to_bytes(x), dtype=np.uint8) for x in a])
     check(a, limbs.bytes_to_limbs(rows))
+
+
+def test_mul_variants_bit_exact():
+    """The matmulfold mul variant agrees with the schoolbook path and the
+    host oracle (CPZK_MUL A/B safety — VERDICT r2 item 2), including on
+    mixed-sign-half loose carried-form inputs (the shape that overflowed
+    the removed Karatsuba variant)."""
+    import secrets
+
+    import jax
+
+    from cpzk_tpu.ops import limbs as m
+
+    xs = [secrets.randbelow(m.P) for _ in range(32)] + [m.P - 1, 0, 1]
+    ys = [secrets.randbelow(m.P) for _ in range(32)] + [m.P - 1, m.P - 1, 2]
+    a, b = m.ints_to_limbs(xs), m.ints_to_limbs(ys)
+    exp = [x * y % m.P for x, y in zip(xs, ys)]
+
+    def run(variant):
+        old = m.MUL_VARIANT
+        m.MUL_VARIANT = variant
+        try:
+            # jit cache keys on the traced graph, not the module global:
+            # trace fresh each time
+            return m.limbs_to_ints(m.canonical(m.mul(a, b)))
+        finally:
+            m.MUL_VARIANT = old
+
+    for variant in ("schoolbook", "matmulfold"):
+        assert run(variant) == exp, variant
+
+    # adversarial max-limb carried-form inputs with MIXED-SIGN halves —
+    # the shape that overflowed the removed Karatsuba variant's middle
+    # product (review r3): low half +bound, high half -bound
+    import numpy as np
+
+    am = np.concatenate([np.full((10, 3), 9500), np.full((10, 3), -9500)]).astype(np.int32)
+    bm = np.concatenate([np.full((10, 3), -9500), np.full((10, 3), 9500)]).astype(np.int32)
+    ia, ib = m.limbs_to_int(am[:, 0]), m.limbs_to_int(bm[:, 0])
+    for variant in ("schoolbook", "matmulfold"):
+        old = m.MUL_VARIANT
+        m.MUL_VARIANT = variant
+        try:
+            out = m.limbs_to_ints(m.canonical(m.mul(am, bm)))
+        finally:
+            m.MUL_VARIANT = old
+        assert all(v == ia * ib % m.P for v in out), variant
