@@ -60,13 +60,23 @@ class Element:
     paths need coordinates).
     """
 
-    __slots__ = ("_point", "_wire")
+    __slots__ = ("_point", "_wire", "_validated")
 
-    def __init__(self, point: edwards.Point | None = None, wire: bytes | None = None):
+    def __init__(
+        self,
+        point: edwards.Point | None = None,
+        wire: bytes | None = None,
+        validated: bool = False,
+    ):
         if point is None and wire is None:
             raise ValueError("Element needs a point or wire bytes")
         self._point = point
         self._wire = wire
+        # True when this element's wire bytes have already passed canonical
+        # decode (element_from_bytes) — recompression validation is then a
+        # no-op re-check and is skipped (the reference's validate exists to
+        # catch non-canonical encodings, which the parse already rejects)
+        self._validated = validated
 
     @property
     def point(self) -> edwards.Point:
@@ -143,11 +153,11 @@ class Ristretto255:
         if rt is not None:
             if rt == b"":
                 raise InvalidGroupElement("Bytes do not represent a valid Ristretto point")
-            return Element(wire=bytes(data))
+            return Element(wire=bytes(data), validated=True)
         point = edwards.ristretto_decode(data)
         if point is None:
             raise InvalidGroupElement("Bytes do not represent a valid Ristretto point")
-        return Element(point, bytes(data))
+        return Element(point, bytes(data), validated=True)
 
     @staticmethod
     def element_to_bytes(element: Element) -> bytes:
@@ -219,6 +229,8 @@ class Ristretto255:
         otherwise encode→decode must round-trip to the same coset.  Uses the
         C++ core's decode+encode when available (same canonical rules,
         enforced bit-exact by tests/test_native.py)."""
+        if element._validated:
+            return  # parse-time canonical decode already proved validity
         if Ristretto255.is_identity(element):
             return
         compressed = element.wire()

@@ -89,7 +89,13 @@ class TpuBackend(VerifierBackend):
     prefers_combined = True
 
     def __init__(self, mesh_devices: int | None = None):
+        import threading
+
         self._gh_cache: dict[tuple[bytes, bytes], tuple[curve.Point, curve.Point]] = {}
+        # the pipelined batcher calls verify_* from multiple worker
+        # threads; guard the check-then-insert so a cold generator pair
+        # is marshalled once, not once per concurrent batch
+        self._gh_lock = threading.Lock()
         self._mesh = None
         self._sharded_each = None
         self._sharded_msm = None
@@ -112,14 +118,15 @@ class TpuBackend(VerifierBackend):
             Ristretto255.element_to_bytes(row.g),
             Ristretto255.element_to_bytes(row.h),
         )
-        if key not in self._gh_cache:
-            # single shared points keep a size-1 batch axis ([20, 1] coords)
-            # and broadcast against the [20, n] row arrays
-            self._gh_cache[key] = (
-                curve.points_to_device([row.g.point]),
-                curve.points_to_device([row.h.point]),
-            )
-        return self._gh_cache[key]
+        with self._gh_lock:
+            if key not in self._gh_cache:
+                # single shared points keep a size-1 batch axis ([20, 1]
+                # coords) and broadcast against the [20, n] row arrays
+                self._gh_cache[key] = (
+                    curve.points_to_device([row.g.point]),
+                    curve.points_to_device([row.h.point]),
+                )
+            return self._gh_cache[key]
 
     # -- VerifierBackend interface ------------------------------------------
 

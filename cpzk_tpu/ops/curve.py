@@ -121,6 +121,21 @@ def double(p: Point) -> Point:
     return (limbs.mul(E, F), limbs.mul(G, H), limbs.mul(F, G), limbs.mul(E, H))
 
 
+def double_k(p: Point, k: int) -> Point:
+    """k consecutive doublings (k static).  With the Pallas path enabled
+    this is ONE fused kernel keeping intermediates in VMEM — the ladder's
+    WINDOW_BITS-per-step doubling run is the hottest op sequence in both
+    verify kernels; the XLA fallback is a plain loop."""
+    if k == 0:
+        return p
+    pk = _pallas()
+    if pk is not None and pk.supported(p):
+        return pk.point_double_k(p, k)
+    for _ in range(k):
+        p = double(p)
+    return p
+
+
 def negate(p: Point) -> Point:
     X, Y, Z, T = p
     return (limbs.neg(X), Y, Z, limbs.neg(T))

@@ -69,22 +69,6 @@ def _add_kernel(x1, y1, z1, t1, x2, y2, z2, t2, d2, ox, oy, oz, ot):
     ot[...] = limbs.mul(E, H)
 
 
-def _double_kernel(x1, y1, z1, ox, oy, oz, ot):
-    """a=-1 doubling on one [20, BLOCK] block."""
-    X1, Y1, Z1 = x1[...], y1[...], z1[...]
-    A = limbs.square(X1)
-    B = limbs.square(Y1)
-    C = limbs.mul_small(limbs.square(Z1), 2)
-    H = limbs.add(A, B)
-    E = limbs.sub(H, limbs.square(limbs.add(X1, Y1)))
-    G = limbs.sub(A, B)
-    F = limbs.add(C, G)
-    ox[...] = limbs.mul(E, F)
-    oy[...] = limbs.mul(G, H)
-    oz[...] = limbs.mul(F, G)
-    ot[...] = limbs.mul(E, H)
-
-
 @functools.cache
 def _add_call(n: int, block: int, interpret: bool):
     spec = pl.BlockSpec((NLIMBS, block), lambda i: (0, i))
@@ -100,12 +84,37 @@ def _add_call(n: int, block: int, interpret: bool):
     )
 
 
+def _double_k_kernel(k: int, x1, y1, z1, ox, oy, oz, ot):
+    """k fused a=-1 doublings on one [20, BLOCK] block.
+
+    This is the payoff kernel: the windowed ladders do WINDOW_BITS
+    consecutive doublings per step, and fusing them keeps all
+    intermediate coordinates in VMEM — the XLA path round-trips 4 HBM
+    arrays between each doubling.  T is only produced on the last round
+    (doubling consumes X/Y/Z)."""
+    X, Y, Z = x1[...], y1[...], z1[...]
+    E = H = None
+    for _ in range(k):
+        A = limbs.square(X)
+        B = limbs.square(Y)
+        C = limbs.mul_small(limbs.square(Z), 2)
+        H = limbs.add(A, B)
+        E = limbs.sub(H, limbs.square(limbs.add(X, Y)))
+        G = limbs.sub(A, B)
+        F = limbs.add(C, G)
+        X, Y, Z = limbs.mul(E, F), limbs.mul(G, H), limbs.mul(F, G)
+    ox[...] = X
+    oy[...] = Y
+    oz[...] = Z
+    ot[...] = limbs.mul(E, H)
+
+
 @functools.cache
-def _double_call(n: int, block: int, interpret: bool):
+def _double_k_call(k: int, n: int, block: int, interpret: bool):
     spec = pl.BlockSpec((NLIMBS, block), lambda i: (0, i))
     out = jax.ShapeDtypeStruct((NLIMBS, n), jnp.int32)
     return pl.pallas_call(
-        _double_kernel,
+        functools.partial(_double_k_kernel, k),
         grid=(n // block,),
         in_specs=[spec] * 3,
         out_specs=[spec] * 4,
@@ -130,7 +139,13 @@ def point_add(p: Point, q: Point) -> Point:
 
 
 def point_double(p: Point) -> Point:
+    return point_double_k(p, 1)
+
+
+def point_double_k(p: Point, k: int) -> Point:
+    """k fused doublings in one kernel launch (k >= 1, static)."""
+    assert k >= 1, "point_double_k needs k >= 1 (callers guard k == 0)"
     n = p[0].shape[-1]
     block = min(BLOCK, n)
-    fn = _double_call(n, block, _interpret())
+    fn = _double_k_call(k, n, block, _interpret())
     return tuple(fn(p[0], p[1], p[2]))

@@ -46,8 +46,7 @@ def _msm_rows(tables: list[tuple[jnp.ndarray, ...]], windows: list[jnp.ndarray])
     wT = jnp.stack(windows, axis=1)  # [64, K, ...]
 
     def step(acc: Point, w):
-        for _ in range(WINDOW_BITS):
-            acc = curve.double(acc)
+        acc = curve.double_k(acc, WINDOW_BITS)
         for k, table in enumerate(tables):
             acc = curve.add(acc, table_gather(table, w[k]))
         return acc, None

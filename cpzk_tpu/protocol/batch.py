@@ -66,7 +66,13 @@ class BatchRow:
 
 
 class VerifierBackend:
-    """Backend interface for the batch-verification compute plane."""
+    """Backend interface for the batch-verification compute plane.
+
+    Thread-safety contract: the serving layer's pipelined batcher
+    (``DynamicBatcher(pipeline_depth>1)``) calls ``verify_combined`` /
+    ``verify_each`` for DIFFERENT batches concurrently from worker
+    threads.  Implementations must tolerate that — keep per-call state on
+    the stack and guard any shared caches (see ``TpuBackend._gh``)."""
 
     #: Whether the combined RLC fast path is actually faster than per-proof
     #: checks on this backend. False for the scalar CPU oracle (4n+2 muls vs
@@ -166,9 +172,12 @@ class FailoverBackend(VerifierBackend):
     """
 
     def __init__(self, primary: VerifierBackend, fallback: VerifierBackend):
+        import threading
+
         self.primary = primary
         self.fallback = fallback
         self.degraded = False
+        self._degrade_lock = threading.Lock()
 
     @property
     def prefers_combined(self) -> bool:  # type: ignore[override]
@@ -181,10 +190,16 @@ class FailoverBackend(VerifierBackend):
     def _note_failure(self, exc: Exception) -> None:
         import logging
 
+        # pipelined dispatches call backends from multiple threads; only
+        # the first failure logs/counts, and degradation is permanent
+        # until reset()
+        with self._degrade_lock:
+            if self.degraded:
+                return
+            self.degraded = True
         logging.getLogger("cpzk_tpu.protocol.batch").exception(
             "primary verifier backend failed; degrading to fallback: %s", exc
         )
-        self.degraded = True
         try:  # metrics live in the server layer; optional here
             from ..server import metrics
 

@@ -49,6 +49,7 @@ class DynamicBatcher:
         max_batch: int = 4096,
         window_ms: float = 5.0,
         max_queue: int | None = None,
+        pipeline_depth: int = 2,
     ):
         self.backend = backend
         self.max_batch = max_batch
@@ -56,6 +57,14 @@ class DynamicBatcher:
         # dispatcher drains max_batch per pass, so 4x is ~4 windows of grace
         self.max_queue = max_queue if max_queue is not None else 4 * max_batch
         self.window = window_ms / 1000.0
+        # host-pipeline overlap (SURVEY §2.3 PP analog): up to
+        # pipeline_depth batches in flight, so batch k+1's host stage
+        # (challenge hashing, limb marshalling — GIL-releasing native and
+        # numpy work) overlaps batch k's device compute.  Depth 1 restores
+        # strictly serial dispatch.
+        self.pipeline_depth = max(1, pipeline_depth)
+        self._inflight: asyncio.Semaphore | None = None
+        self._dispatches: set[asyncio.Task] = set()
         self._queue: list[tuple[BatchEntry, asyncio.Future]] = []
         self._wakeup: asyncio.Event = asyncio.Event()
         self._task: asyncio.Task | None = None
@@ -68,12 +77,14 @@ class DynamicBatcher:
         self._task = asyncio.get_running_loop().create_task(self._run())
 
     async def stop(self) -> None:
-        """Drain the queue, then stop the dispatcher."""
+        """Drain the queue and all in-flight dispatches, then stop."""
         self._stopping = True
         self._wakeup.set()
         if self._task is not None:
             await self._task
             self._task = None
+        if self._dispatches:
+            await asyncio.gather(*tuple(self._dispatches), return_exceptions=True)
 
     # -- submission --------------------------------------------------------
 
@@ -126,14 +137,31 @@ class DynamicBatcher:
                 except asyncio.TimeoutError:
                     break
 
+            if self._inflight is None:
+                self._inflight = asyncio.Semaphore(self.pipeline_depth)
             while self._queue:
                 take = self._queue[: self.max_batch]
                 del self._queue[: len(take)]
                 metrics.gauge("tpu.queue.depth").set(len(self._queue))
-                await self._dispatch(take)
+                # bounded pipeline: block only when pipeline_depth batches
+                # are already in flight; otherwise batch k+1's host prep
+                # overlaps batch k's device compute on another thread
+                await self._inflight.acquire()
+                task = asyncio.get_running_loop().create_task(
+                    self._dispatch_release(take)
+                )
+                self._dispatches.add(task)
+                task.add_done_callback(self._dispatches.discard)
 
             if self._stopping and not self._queue:
                 return
+
+    async def _dispatch_release(self, take) -> None:
+        try:
+            await self._dispatch(take)
+        finally:
+            assert self._inflight is not None
+            self._inflight.release()
 
     async def _dispatch(self, take: list[tuple[BatchEntry, asyncio.Future]]) -> None:
         entries = [e for e, _ in take]

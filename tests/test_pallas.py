@@ -53,3 +53,15 @@ def test_supported_predicate(pts):
     assert pallas_kernels.supported(dp)
     small = tuple(c[:, :4] for c in dp)
     assert not pallas_kernels.supported(small)  # < 128 lanes -> XLA path
+
+
+def test_pallas_double_k_matches_xla(pts):
+    """The fused k-doubling kernel is bit-exact vs k host doublings
+    (interpret mode off-TPU)."""
+    host, dp = pts
+    pal = pallas_kernels.point_double_k(dp, 4)
+    for got, p in zip(canon(pal), host):
+        exp = p
+        for _ in range(4):
+            exp = he.pt_double(exp)
+        assert he.pt_eq(got, exp)

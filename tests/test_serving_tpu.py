@@ -344,3 +344,53 @@ def test_multihost_single_process_noop():
     import jax
 
     assert mesh.devices.size == len(jax.devices())
+
+
+def test_batcher_pipelines_dispatches():
+    """PP analog (SURVEY §2.3): with pipeline_depth=2 the second batch's
+    dispatch starts while the first is still on the device thread."""
+    import threading
+    import time as _time
+
+    release = threading.Event()
+    starts: list[float] = []
+
+    class SlowBackend(VerifierBackend):
+        prefers_combined = False
+
+        def verify_combined(self, rows, beta):  # pragma: no cover
+            raise AssertionError("unused")
+
+        def verify_each(self, rows):
+            starts.append(_time.monotonic())
+            release.wait(5.0)
+            return [True] * len(rows)
+
+    params, proofs = make_proofs(4)
+
+    async def main():
+        batcher = DynamicBatcher(
+            SlowBackend(), max_batch=2, window_ms=1.0, pipeline_depth=2
+        )
+        batcher.start()
+        coros = [batcher.submit(params, st, pr, None) for st, pr in proofs]
+        fut = asyncio.gather(*coros)
+        # both dispatches (2 batches of 2) must hit the backend while
+        # neither has completed — i.e. overlap, not serial awaits.  The
+        # assertion happens BEFORE release.set(): under serial dispatch
+        # the first batch blocks in release.wait and the second never
+        # starts, so the poll loop exhausts and we fail here.
+        overlapped = False
+        for _ in range(200):
+            if len(starts) >= 2:
+                overlapped = True
+                break
+            await asyncio.sleep(0.02)
+        release.set()
+        results = await fut
+        await batcher.stop()
+        return results, overlapped
+
+    results, overlapped = run(main())
+    assert results == [None] * 4
+    assert overlapped, "second dispatch never started while first was in flight"
