@@ -151,7 +151,10 @@ class Ristretto255:
         # ingress) never need them.
         rt = _native.point_roundtrip(bytes(data))
         if rt is not None:
-            if rt == b"":
+            # canonical decode implies rt == data; the equality check is
+            # free defense-in-depth against a decoder accepting a
+            # non-canonical encoding (would re-encode differently)
+            if rt != bytes(data):
                 raise InvalidGroupElement("Bytes do not represent a valid Ristretto point")
             return Element(wire=bytes(data), validated=True)
         point = edwards.ristretto_decode(data)

@@ -232,8 +232,7 @@ def scalar_mul(p: Point, windows: jnp.ndarray) -> Point:
     table = build_table(p)
 
     def step(acc: Point, w: jnp.ndarray) -> tuple[Point, None]:
-        for _ in range(WINDOW_BITS):
-            acc = double(acc)
+        acc = double_k(acc, WINDOW_BITS)
         return add(acc, table_gather(table, w)), None
 
     acc0 = identity(windows.shape[1:])

@@ -38,6 +38,8 @@ sort/gather is fine (docs/security.md).
 
 from __future__ import annotations
 
+import os
+
 import numpy as np
 
 import jax.numpy as jnp
@@ -48,7 +50,16 @@ from .curve import Point
 
 
 def pick_window(m: int) -> int:
-    """Static window size minimizing ~K(c) * (2m + 3 * 2^(c-1))."""
+    """Static window size minimizing ~K(c) * (2m + 3 * 2^(c-1)).
+
+    ``CPZK_MSM_WINDOW`` (4..16) overrides the cost model — the knob the
+    on-hardware sweep uses to calibrate it (PROFILE.md §4)."""
+    override = os.environ.get("CPZK_MSM_WINDOW")
+    if override:
+        c = int(override)
+        if not 4 <= c <= 16:
+            raise ValueError(f"CPZK_MSM_WINDOW={c} outside 4..16")
+        return c
     best_c, best_cost = 4, float("inf")
     for c in range(4, 17):
         cost = num_windows(c) * (2 * m + 3 * (1 << (c - 1)))
@@ -130,8 +141,7 @@ def msm_kernel(points: Point, digits: jnp.ndarray, c: int) -> Point:
     n_buckets = (1 << (c - 1)) + 1  # bucket values 0..2^(c-1)
 
     def step(acc: Point, d):
-        for _ in range(c):
-            acc = curve.double(acc)
+        acc = curve.double_k(acc, c)
         w = _window_sum(points, d, n_buckets)
         return curve.add(acc, w), None
 

@@ -82,6 +82,7 @@ def build_backend(config):
         backend,
         max_batch=config.tpu.batch_max,
         window_ms=config.tpu.batch_window_ms,
+        pipeline_depth=config.tpu.pipeline_depth,
     )
     return backend, batcher
 

@@ -50,6 +50,7 @@ class TpuSettings:
     batch_max: int = 4096         # dynamic-batcher device batch target
     batch_window_ms: float = 5.0  # queue deadline before dispatch
     mesh_devices: int = 0         # 0 = all visible devices
+    pipeline_depth: int = 2       # in-flight batches (1 = serial dispatch)
 
 
 @dataclass
@@ -141,6 +142,8 @@ class ServerConfig:
             self.tpu.batch_window_ms = float(v)
         if (v := get("TPU_MESH_DEVICES")) is not None:
             self.tpu.mesh_devices = int(v)
+        if (v := get("TPU_PIPELINE_DEPTH")) is not None:
+            self.tpu.pipeline_depth = int(v)
 
     # --- validation (config.rs:238-273) ---
 
@@ -162,6 +165,8 @@ class ServerConfig:
             raise ValueError("Rate limit burst cannot be zero")
         if self.tpu.backend not in ("cpu", "tpu"):
             raise ValueError(f"Unknown verifier backend: {self.tpu.backend}")
+        if self.tpu.pipeline_depth < 1:
+            raise ValueError("tpu.pipeline_depth must be >= 1")
         if self.tpu.batch_max < 1:
             raise ValueError("tpu.batch_max must be positive")
         if self.tpu.batch_window_ms < 0:
