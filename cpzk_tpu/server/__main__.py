@@ -60,6 +60,12 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--batch-window-ms", type=float, default=None,
                    help="dynamic-batcher queue deadline in ms (tpu backend)")
     p.add_argument("--no-repl", action="store_true", help="run headless (no admin REPL)")
+    p.add_argument("--state-file", default=None,
+                   help="opt-in checkpoint/resume: restore users+sessions "
+                        "from this JSON snapshot at boot (when it exists) "
+                        "and write it on graceful shutdown and every "
+                        "cleanup sweep. Default: in-memory only "
+                        "(reference parity)")
     return p.parse_args(argv)
 
 
@@ -87,9 +93,11 @@ def build_backend(config):
     return backend, batcher
 
 
-async def cleanup_supervisor(state: ServerState, stop: asyncio.Event) -> None:
+async def cleanup_supervisor(
+    state: ServerState, stop: asyncio.Event, state_file: str | None = None
+) -> None:
     """Periodic expiry sweeps under a restart-on-crash supervisor
-    (server.rs:168-192)."""
+    (server.rs:168-192); with --state-file, each sweep also checkpoints."""
 
     async def sweep_loop():
         while not stop.is_set():
@@ -102,6 +110,8 @@ async def cleanup_supervisor(state: ServerState, stop: asyncio.Event) -> None:
             ns = await state.cleanup_expired_sessions()
             if nc or ns:
                 log.info("cleanup: %d challenges, %d sessions expired", nc, ns)
+            if state_file:
+                await state.snapshot(state_file)
 
     while not stop.is_set():
         try:
@@ -181,6 +191,8 @@ def resolve_config(args) -> ServerConfig:
         config.tpu.batch_max = args.batch_max
     if args.batch_window_ms is not None:
         config.tpu.batch_window_ms = args.batch_window_ms
+    if args.state_file is not None:
+        config.state_file = args.state_file
     config.validate()
     return config
 
@@ -195,10 +207,15 @@ async def amain(args) -> None:
     )
 
     state = ServerState()
+    if config.state_file and os.path.exists(config.state_file):
+        nu, ns = await state.restore(config.state_file)
+        log.info("restored state snapshot: %d users, %d sessions", nu, ns)
     limiter = config.rate_limit.build_limiter()
     stop = asyncio.Event()
 
-    cleanup_task = asyncio.create_task(cleanup_supervisor(state, stop))
+    cleanup_task = asyncio.create_task(
+        cleanup_supervisor(state, stop, config.state_file or None)
+    )
 
     if config.metrics.enabled:
         from . import metrics
@@ -261,6 +278,9 @@ async def amain(args) -> None:
     if batcher is not None:
         await batcher.stop()  # drain queued verifications before the listener
     await server.stop(grace=5)
+    if config.state_file:
+        await state.snapshot(config.state_file)
+        log.info("state snapshot written to %s", config.state_file)
     cleanup_task.cancel()
     if repl_task is not None:
         repl_task.cancel()

@@ -57,6 +57,8 @@ class TpuSettings:
 class ServerConfig:
     host: str = "127.0.0.1"
     port: int = 50051
+    # opt-in checkpoint/resume (empty = in-memory only, reference parity)
+    state_file: str = ""
     rate_limit: RateLimitSettings = field(default_factory=RateLimitSettings)
     metrics: MetricsSettings = field(default_factory=MetricsSettings)
     tls: TlsSettings = field(default_factory=TlsSettings)
@@ -83,6 +85,8 @@ class ServerConfig:
             self.host = str(data["host"])
         if "port" in data:
             self.port = int(data["port"])
+        if "state_file" in data:
+            self.state_file = str(data["state_file"])
         for section, obj in (
             ("rate_limit", self.rate_limit),
             ("metrics", self.metrics),
@@ -117,6 +121,8 @@ class ServerConfig:
             self.host = v
         if (v := get("PORT")) is not None:
             self.port = int(v)
+        if (v := get("STATE_FILE")) is not None:
+            self.state_file = v
         # short aliases mirror the reference's clap env names
         if (v := get_alias("RATE_LIMIT_REQUESTS_PER_MINUTE", "RATE_LIMIT")) is not None:
             self.rate_limit.requests_per_minute = int(v)

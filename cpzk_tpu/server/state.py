@@ -87,6 +87,9 @@ class ServerState:
         self._user_challenges: dict[str, list[bytes]] = {}
         self._sessions: dict[str, SessionData] = {}
         self._user_sessions: dict[str, list[str]] = {}
+        # set on any change to persisted data (users/sessions); lets the
+        # periodic snapshot skip writes on an idle server
+        self._persist_dirty = True
 
     # --- users (state.rs:136-161) ---
 
@@ -99,6 +102,7 @@ class ServerState:
             if user_data.user_id in self._users:
                 raise InvalidParams(f"User '{user_data.user_id}' already registered")
             self._users[user_data.user_id] = user_data
+            self._persist_dirty = True
 
     async def get_user(self, user_id: str) -> UserData | None:
         async with self._lock:
@@ -165,6 +169,7 @@ class ServerState:
                 )
             self._sessions[token] = SessionData(token=token, user_id=user_id)
             per_user.append(token)
+            self._persist_dirty = True
 
     async def validate_session(self, token: str) -> str:
         async with self._lock:
@@ -183,6 +188,7 @@ class ServerState:
             per_user = self._user_sessions.get(data.user_id)
             if per_user is not None and token in per_user:
                 per_user.remove(token)
+            self._persist_dirty = True
 
     async def cleanup_expired_sessions(self) -> int:
         async with self._lock:
@@ -192,6 +198,8 @@ class ServerState:
                 per_user = self._user_sessions.get(data.user_id)
                 if per_user is not None and t in per_user:
                     per_user.remove(t)
+            if expired:
+                self._persist_dirty = True
             return len(expired)
 
     # --- counts (state.rs:330-342) ---
@@ -207,3 +215,131 @@ class ServerState:
     async def challenge_count(self) -> int:
         async with self._lock:
             return len(self._challenges)
+
+    # --- snapshot / restore (checkpoint-resume, SURVEY.md §5) -------------
+    #
+    # The reference has no persistence: a restart loses everything
+    # (state.rs holds only in-memory maps).  In-memory remains this
+    # framework's default for parity; snapshots are OPT-IN new capability
+    # (--state-file).  Scope: users and sessions — challenges are 300-second
+    # single-use nonces, and persisting them would extend their attack
+    # window across restarts for no operational benefit (clients simply
+    # re-request).  Format: versioned JSON, public data only (statements
+    # are public by protocol design; session tokens are bearer secrets, so
+    # the file must be protected like a session store — written 0600).
+
+    SNAPSHOT_VERSION = 1
+
+    async def snapshot(self, path: str) -> bool:
+        """Write users + live sessions to ``path`` (JSON); returns whether
+        a write happened (skipped when nothing changed since the last
+        snapshot).  The in-memory copy is taken under the state lock; the
+        serialization + fsync + atomic rename run on a worker thread so
+        the event loop (and every handler waiting on the lock) never
+        stalls on disk I/O."""
+        import asyncio as _asyncio
+        import json
+        import os
+
+        from ..core.ristretto import Ristretto255
+
+        eb = Ristretto255.element_to_bytes
+        async with self._lock:
+            if not self._persist_dirty:
+                return False
+            doc = {
+                "version": self.SNAPSHOT_VERSION,
+                "users": {
+                    uid: {
+                        "y1": eb(u.statement.y1).hex(),
+                        "y2": eb(u.statement.y2).hex(),
+                        "registered_at": u.registered_at,
+                    }
+                    for uid, u in self._users.items()
+                },
+                "sessions": [
+                    {
+                        "token": s.token,
+                        "user_id": s.user_id,
+                        "created_at": s.created_at,
+                        "expires_at": s.expires_at,
+                    }
+                    for s in self._sessions.values()
+                    if not s.is_expired()
+                ],
+            }
+            self._persist_dirty = False
+
+        def write() -> None:
+            tmp = f"{path}.tmp"
+            fd = os.open(tmp, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+            with os.fdopen(fd, "w") as f:
+                json.dump(doc, f)
+                f.flush()
+                os.fsync(f.fileno())  # data durable before the rename
+            os.replace(tmp, path)
+
+        try:
+            await _asyncio.to_thread(write)
+        except BaseException:
+            self._persist_dirty = True  # retry next sweep
+            raise
+        return True
+
+    async def restore(self, path: str) -> tuple[int, int]:
+        """Load a snapshot into an empty state; returns (users, sessions).
+
+        The file is a trust boundary: statements re-validate through the
+        canonical decoder, every capacity cap is enforced, sessions must
+        reference registered users and carry sane expiries — a corrupt or
+        tampered file fails loudly rather than registering garbage."""
+        import json
+
+        from ..core.ristretto import Ristretto255
+
+        with open(path, encoding="utf-8") as f:
+            doc = json.load(f)
+        if doc.get("version") != self.SNAPSHOT_VERSION:
+            raise InvalidParams(
+                f"Unsupported state snapshot version: {doc.get('version')!r}"
+            )
+        async with self._lock:
+            if self._users or self._sessions:
+                raise InvalidParams("restore requires an empty state")
+            if len(doc["users"]) > MAX_TOTAL_USERS:
+                raise InvalidParams("Snapshot exceeds the user capacity cap")
+            if len(doc["sessions"]) > MAX_TOTAL_SESSIONS:
+                raise InvalidParams("Snapshot exceeds the session capacity cap")
+            for uid, u in doc["users"].items():
+                st = Statement(
+                    Ristretto255.element_from_bytes(bytes.fromhex(u["y1"])),
+                    Ristretto255.element_from_bytes(bytes.fromhex(u["y2"])),
+                )
+                self._users[uid] = UserData(
+                    user_id=uid, statement=st, registered_at=int(u["registered_at"])
+                )
+            n_sessions = 0
+            for s in doc["sessions"]:
+                created, expires = int(s["created_at"]), int(s["expires_at"])
+                if expires <= created or expires - created > SESSION_EXPIRY_SECONDS:
+                    raise InvalidParams("Snapshot session has an invalid expiry")
+                data = SessionData(
+                    token=str(s["token"]),
+                    user_id=str(s["user_id"]),
+                    created_at=created,
+                    expires_at=expires,
+                )
+                if data.user_id not in self._users:
+                    raise InvalidParams(
+                        "Snapshot session references an unregistered user"
+                    )
+                if data.is_expired():
+                    continue
+                per_user = self._user_sessions.setdefault(data.user_id, [])
+                if len(per_user) >= MAX_SESSIONS_PER_USER:
+                    raise InvalidParams("Snapshot exceeds a per-user session cap")
+                self._sessions[data.token] = data
+                per_user.append(data.token)
+                n_sessions += 1
+            self._persist_dirty = True  # freshly-restored state is unsaved
+            return len(self._users), n_sessions

@@ -1,0 +1,181 @@
+"""Checkpoint/resume (SURVEY.md §5): opt-in state snapshots.
+
+In-memory remains the default (reference parity — state.rs holds only
+maps and a restart loses everything); --state-file adds versioned-JSON
+persistence of users + live sessions.  Challenges are deliberately NOT
+persisted (300-second single-use nonces; resurrection across restarts
+would widen their replay window)."""
+
+import asyncio
+import json
+import os
+
+import pytest
+
+from cpzk_tpu import Parameters, Prover, SecureRng, Witness
+from cpzk_tpu.core.ristretto import Ristretto255
+from cpzk_tpu.errors import Error
+from cpzk_tpu.server.state import ServerState, SessionData, UserData
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def make_statement(rng, params):
+    return Prover(params, Witness(Ristretto255.random_scalar(rng))).statement
+
+
+def test_snapshot_restore_roundtrip(tmp_path):
+    rng, params = SecureRng(), Parameters.new()
+    path = str(tmp_path / "state.json")
+
+    async def main():
+        st = ServerState()
+        stmts = {}
+        for i in range(3):
+            stmts[f"u{i}"] = make_statement(rng, params)
+            await st.register_user(UserData(f"u{i}", stmts[f"u{i}"], 1234 + i))
+        await st.create_session("tok-a", "u0")
+        await st.create_session("tok-b", "u1")
+        await st.create_challenge("u0", b"c" * 32)  # must NOT persist
+        await st.snapshot(path)
+
+        st2 = ServerState()
+        nu, ns = await st2.restore(path)
+        assert (nu, ns) == (3, 2)
+        for i in range(3):
+            u = await st2.get_user(f"u{i}")
+            assert u is not None and u.statement == stmts[f"u{i}"]
+            assert u.registered_at == 1234 + i
+        assert await st2.validate_session("tok-a") == "u0"
+        assert await st2.challenge_count() == 0
+        # restored per-user session indexes enforce the cap
+        for i in range(4):
+            await st2.create_session(f"x{i}", "u0")
+        with pytest.raises(Error, match="maximum session limit"):
+            await st2.create_session("x5", "u0")
+
+    run(main())
+    assert os.stat(path).st_mode & 0o777 == 0o600
+
+
+def test_restore_rejects_bad_input(tmp_path):
+    rng, params = Parameters, None  # unused
+    path = str(tmp_path / "state.json")
+
+    async def main():
+        # wrong version
+        with open(path, "w") as f:
+            json.dump({"version": 99, "users": {}, "sessions": []}, f)
+        with pytest.raises(Error, match="version"):
+            await ServerState().restore(path)
+
+        # tampered statement bytes fail canonical decode
+        with open(path, "w") as f:
+            json.dump(
+                {
+                    "version": 1,
+                    "users": {"evil": {"y1": "ff" * 32, "y2": "ff" * 32,
+                                        "registered_at": 1}},
+                    "sessions": [],
+                },
+                f,
+            )
+        with pytest.raises(Error):
+            await ServerState().restore(path)
+
+        # restore into a non-empty state refuses
+        st = ServerState()
+        r = SecureRng()
+        p = Parameters.new()
+        await st.register_user(UserData("u", make_statement(r, p), 1))
+        with open(path, "w") as f:
+            json.dump({"version": 1, "users": {}, "sessions": []}, f)
+        with pytest.raises(Error, match="empty state"):
+            await st.restore(path)
+
+    run(main())
+
+
+def test_restore_drops_expired_sessions(tmp_path):
+    path = str(tmp_path / "state.json")
+
+    async def main():
+        st = ServerState()
+        rng, params = SecureRng(), Parameters.new()
+        await st.register_user(UserData("u0", make_statement(rng, params), 1))
+        await st.create_session("live", "u0")
+        # inject an expired session directly, then snapshot
+        st._sessions["dead"] = SessionData(
+            token="dead", user_id="u0", created_at=1, expires_at=2
+        )
+        st._user_sessions.setdefault("u0", []).append("dead")
+        await st.snapshot(path)
+
+        st2 = ServerState()
+        _, ns = await st2.restore(path)
+        assert ns == 1
+        assert await st2.validate_session("live") == "u0"
+        with pytest.raises(Error):
+            await st2.validate_session("dead")
+
+    run(main())
+
+
+def test_grpc_restart_with_snapshot(tmp_path):
+    """Register on one server instance, snapshot, restore into a fresh
+    instance, and log in WITHOUT re-registering — the checkpoint/resume
+    end-to-end story."""
+    from cpzk_tpu.client import AuthClient
+    from cpzk_tpu.client.__main__ import do_login, do_register
+    from cpzk_tpu.server import RateLimiter
+    from cpzk_tpu.server.service import serve
+
+    path = str(tmp_path / "state.json")
+
+    async def main():
+        state1 = ServerState()
+        server1, port1 = await serve(state1, RateLimiter(1000, 1000), port=0)
+        async with AuthClient(f"127.0.0.1:{port1}") as c:
+            assert "Registered" in await do_register(c, "carol", "pw-carol")
+        await state1.snapshot(path)
+        await server1.stop(None)
+
+        state2 = ServerState()
+        await state2.restore(path)
+        server2, port2 = await serve(state2, RateLimiter(1000, 1000), port=0)
+        async with AuthClient(f"127.0.0.1:{port2}") as c:
+            assert "Login OK" in await do_login(c, "carol", "pw-carol")
+            bad = await do_login(c, "carol", "wrong")
+            assert "Login OK" not in bad
+        await server2.stop(None)
+
+    run(main())
+
+
+def test_snapshot_skips_when_clean(tmp_path):
+    """Idle servers don't rewrite the snapshot every sweep."""
+    rng, params = SecureRng(), Parameters.new()
+    path = str(tmp_path / "state.json")
+
+    async def main():
+        st = ServerState()
+        await st.register_user(UserData("u", make_statement(rng, params), 1))
+        assert await st.snapshot(path) is True
+        assert await st.snapshot(path) is False  # nothing changed
+        await st.create_session("t", "u")
+        assert await st.snapshot(path) is True
+
+    run(main())
+
+
+def test_state_file_config_layering(tmp_path, monkeypatch):
+    """state_file resolves through the same precedence chain as every
+    other knob (TOML < env < CLI)."""
+    from cpzk_tpu.server.config import ServerConfig
+
+    monkeypatch.chdir(tmp_path)  # no stray .env/config pickup
+    assert ServerConfig.from_env().state_file == ""
+    monkeypatch.setenv("SERVER_STATE_FILE", "/tmp/a.json")
+    assert ServerConfig.from_env().state_file == "/tmp/a.json"
