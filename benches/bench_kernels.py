@@ -7,6 +7,8 @@ Measures, on whatever backend is live (TPU under axon; CPU with
   outer-product vs matmul-fold MXU experiment; a Karatsuba level was
   evaluated and removed — int32 headroom, see PROFILE.md §2);
 - point add/double throughput (XLA path vs Pallas kernels when enabled);
+- Fiat-Shamir challenge derivation (threaded native C++ vs the device
+  Keccak pipeline);
 - the two batch-verify kernels (rowcombined / pippenger) at small N.
 
 Each config runs in-process; variants toggle module globals, re-tracing
@@ -14,7 +16,7 @@ fresh jit graphs.  Timings are best-of-ITERS wall clock around
 block_until_ready.
 
 Usage: python benches/bench_kernels.py [--platform cpu] [--n 65536]
-       [--iters 5] [--only mul|point|verify]
+       [--iters 5] [--only mul|point|challenge|verify]
 """
 
 from __future__ import annotations
@@ -125,6 +127,53 @@ def bench_point(n: int, iters: int) -> None:
                  error=str(e)[:200])
 
 
+def bench_challenge(n: int, iters: int) -> None:
+    """Fiat-Shamir challenge derivation: threaded native C++ (merlin.cpp)
+    vs the device Keccak pipeline (ops/challenge.py) at n rows."""
+    import os as _os
+
+    import numpy as np
+
+    from cpzk_tpu.core import _native
+
+    cols = [
+        np.frombuffer(_os.urandom(32 * n), dtype=np.uint8).reshape(n, 32).copy()
+        for _ in range(7)
+    ]
+    blobs = [c.tobytes() for c in cols]
+
+    if _native.load() is not None:
+        def native_once():
+            return _native.challenge_batch([None] * n, *blobs[1:])
+
+        native_once()
+        best = float("inf")
+        for _ in range(iters):
+            t0 = time.perf_counter()
+            native_once()
+            best = min(best, time.perf_counter() - t0)
+        emit("challenge_native_cpp", n / best / 1e3, "kchal/s", n=n)
+
+    try:
+        # inside the guard: this import pulls jax, and a jax-less host must
+        # still emit the native number above
+        from cpzk_tpu.ops.challenge import derive_challenges_device
+
+        def device_once():
+            out = derive_challenges_device(None, *cols[1:])
+            return out
+
+        device_once()  # compile + warm; output is host numpy (blocking)
+        best = float("inf")
+        for _ in range(iters):
+            t0 = time.perf_counter()
+            device_once()
+            best = min(best, time.perf_counter() - t0)
+        emit("challenge_device", n / best / 1e3, "kchal/s", n=n)
+    except Exception as e:
+        emit("challenge_device", 0.0, "kchal/s", n=n, error=str(e)[:200])
+
+
 def bench_verify(n: int, iters: int) -> None:
     """rowcombined + pippenger end-to-end device timings at modest N —
     the same kernels bench.py guards, but runnable inline for tuning."""
@@ -154,7 +203,8 @@ def main() -> None:
     ap.add_argument("--n", type=int, default=65536)
     ap.add_argument("--verify-n", type=int, default=4096)
     ap.add_argument("--iters", type=int, default=5)
-    ap.add_argument("--only", default=None, choices=(None, "mul", "point", "verify"))
+    ap.add_argument("--only", default=None,
+                    choices=(None, "mul", "point", "verify", "challenge"))
     args = ap.parse_args()
 
     if args.platform:
@@ -170,6 +220,8 @@ def main() -> None:
         bench_mul(args.n, args.iters)
     if args.only in (None, "point"):
         bench_point(args.n, args.iters)
+    if args.only in (None, "challenge"):
+        bench_challenge(args.n, args.iters)
     if args.only in (None, "verify"):
         bench_verify(args.verify_n, args.iters)
 

@@ -1,0 +1,208 @@
+"""Batched Fiat-Shamir challenge derivation with device Keccak.
+
+SURVEY.md §7 hard part 4: at the 1M proofs/sec north star, per-proof
+Merlin transcript hashing (3 Keccak-f[1600] permutations per proof)
+becomes a host bottleneck.  The STROBE byte bookkeeping is *data-
+independent* when every row absorbs the same-shaped messages — which is
+exactly the serving case (fixed 32-byte challenge-id contexts, 32-byte
+point encodings) — so the entire transcript schedule reduces to:
+
+    state_0  (shared prefix, concrete bytes, computed once on host)
+    state ^= M_1 ; permute ; state ^= M_2 ; permute ; ... ; permute
+    challenge = state[0:64]
+
+where the XOR masks M_j are built on the host with vectorized numpy
+(byte placement only — no hashing), and the permutations — all the
+actual cryptographic work — run batched on the device
+(:func:`cpzk_tpu.ops.keccak.keccak_f1600`, batch on the vector lanes).
+
+``derive_challenges_device`` is bit-identical to the host/native
+transcript paths (tests/test_ops_keccak.py differential); rows must
+share one context length (None = no context append, like the bench and
+example flows).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core import strobe as host_strobe
+from ..core.strobe import FLAG_A, FLAG_C, FLAG_I, FLAG_M, STROBE_R
+from ..core.transcript import CHALLENGE_DST, PROTOCOL_DST, PROTOCOL_LABEL
+from . import keccak as dev_keccak
+
+WIDE = 64
+
+
+class _BatchStrobe:
+    """Replays Strobe128's exact byte schedule over a batch.
+
+    Shared bytes (labels, headers, length prefixes) broadcast; per-row
+    bytes land as [n, L] numpy columns.  Produces the base state plus a
+    list of XOR-mask blocks, one per permutation."""
+
+    def __init__(self, base: "host_strobe.Strobe128", n: int):
+        # concrete shared prefix: state bytes already contain absorbed-
+        # but-unpermuted data, so masks simply continue from its pos
+        self.n = n
+        self.base_state = bytes(base.state)
+        self.pos = base.pos
+        self.pos_begin = base.pos_begin
+        self.cur_flags = base.cur_flags
+        self.cur = np.zeros((200, n), dtype=np.uint8)
+        self.blocks: list[np.ndarray] = []
+
+    # -- strobe internals (twin of core/strobe.py, mask-building form) --
+
+    def _run_f(self) -> None:
+        self.cur[self.pos] ^= self.pos_begin
+        self.cur[self.pos + 1] ^= 0x04
+        self.cur[STROBE_R + 1] ^= 0x80
+        self.blocks.append(self.cur)
+        self.cur = np.zeros((200, self.n), dtype=np.uint8)
+        self.pos = 0
+        self.pos_begin = 0
+
+    def _absorb_shared(self, data: bytes) -> None:
+        for byte in data:
+            self.cur[self.pos] ^= byte
+            self.pos += 1
+            if self.pos == STROBE_R:
+                self._run_f()
+
+    def _absorb_cols(self, cols: np.ndarray) -> None:
+        """cols: [n, L] uint8 per-row message bytes."""
+        off, length = 0, cols.shape[1]
+        while off < length:
+            chunk = min(STROBE_R - self.pos, length - off)
+            self.cur[self.pos : self.pos + chunk] ^= cols[:, off : off + chunk].T
+            self.pos += chunk
+            off += chunk
+            if self.pos == STROBE_R:
+                self._run_f()
+
+    def _begin_op(self, flags: int, more: bool) -> None:
+        if more:
+            assert flags == self.cur_flags
+            return
+        old_begin = self.pos_begin
+        self.pos_begin = self.pos + 1
+        self.cur_flags = flags
+        self._absorb_shared(bytes([old_begin, flags]))
+        if (flags & (FLAG_C | 0x20)) != 0 and self.pos != 0:
+            self._run_f()
+
+    # -- merlin framing --
+
+    def append_message(self, label: bytes, cols: np.ndarray | bytes) -> None:
+        length = len(cols) if isinstance(cols, bytes) else cols.shape[1]
+        self._begin_op(FLAG_M | FLAG_A, False)
+        self._absorb_shared(label)
+        self._begin_op(FLAG_M | FLAG_A, True)
+        self._absorb_shared(length.to_bytes(4, "little"))
+        self._begin_op(FLAG_A, False)
+        if isinstance(cols, bytes):
+            self._absorb_shared(cols)
+        else:
+            self._absorb_cols(cols)
+
+    def finish_challenge(self, label: bytes) -> None:
+        """challenge_bytes(label, 64) up to (and including) the forced
+        permutation; the 64 output bytes are then state[0:64]."""
+        self._begin_op(FLAG_M | FLAG_A, False)
+        self._absorb_shared(label)
+        self._begin_op(FLAG_M | FLAG_A, True)
+        self._absorb_shared(WIDE.to_bytes(4, "little"))
+        self._begin_op(FLAG_I | FLAG_A | FLAG_C, False)
+        # begin_op absorbed 2 header bytes, so pos != 0: the C flag always
+        # forces a permutation here — exactly one final run_f
+        assert self.pos == 0 and not self.cur.any(), "PRF must land on a boundary"
+
+
+import functools
+
+
+@functools.cache
+def _shared_prefix() -> "host_strobe.Strobe128":
+    """Strobe state after the shared Merlin + protocol-DST prefix.
+
+    Depends only on module constants, so it is computed once — the init
+    runs a pure-Python Keccak permutation, which would otherwise be paid
+    per batch in a throughput-oriented API.  _BatchStrobe only reads the
+    snapshot (copies the state bytes), never mutates the cached object.
+    """
+    s = host_strobe.Strobe128(b"Merlin v1.0")
+    # MerlinTranscript(PROTOCOL_LABEL) then append protocol DST
+    for label, msg in ((b"dom-sep", PROTOCOL_LABEL), (b"protocol", PROTOCOL_DST)):
+        s.meta_ad(label, False)
+        s.meta_ad(len(msg).to_bytes(4, "little"), True)
+        s.ad(msg, False)
+    return s
+
+
+def _bytes_to_lanes_np(block: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """[200, n] uint8 -> (hi, lo) [25, n] int32 (little-endian lanes)."""
+    b = block.reshape(25, 8, -1).astype(np.uint64)
+    lane = np.zeros((25, b.shape[2]), dtype=np.uint64)
+    for i in range(8):
+        lane |= b[:, i] << np.uint64(8 * i)
+    hi = (lane >> np.uint64(32)).astype(np.uint32).astype(np.int32)
+    lo = (lane & np.uint64(0xFFFFFFFF)).astype(np.uint32).astype(np.int32)
+    return hi, lo
+
+
+@jax.jit
+def _absorb_permute_chain(s_hi, s_lo, m_hi, m_lo):
+    """state XOR mask -> permute, scanned over the [k, 25, n] mask stack."""
+
+    def step(carry, m):
+        hi, lo = carry
+        hi, lo = dev_keccak.keccak_f1600((hi ^ m[0], lo ^ m[1]))
+        return (hi, lo), None
+
+    (hi, lo), _ = lax.scan(step, (s_hi, s_lo), (m_hi, m_lo))
+    return hi, lo
+
+
+def derive_challenges_device(
+    context_cols: np.ndarray | None,
+    g_cols: np.ndarray,
+    h_cols: np.ndarray,
+    y1_cols: np.ndarray,
+    y2_cols: np.ndarray,
+    r1_cols: np.ndarray,
+    r2_cols: np.ndarray,
+) -> np.ndarray:
+    """[n, 64] challenge bytes for n rows (device permutations).
+
+    Column args are [n, 32] uint8 (context optional, any shared length);
+    the wide reduction mod l stays on the host — the caller feeds the
+    bytes to ``sc_from_bytes_mod_order_wide`` (or keeps them for
+    diagnostics)."""
+    n = g_cols.shape[0]
+    bs = _BatchStrobe(_shared_prefix(), n)
+    if context_cols is not None:
+        bs.append_message(b"context", np.asarray(context_cols, dtype=np.uint8))
+    for label, cols in (
+        (b"generator-g", g_cols), (b"generator-h", h_cols),
+        (b"y1", y1_cols), (b"y2", y2_cols),
+        (b"r1", r1_cols), (b"r2", r2_cols),
+    ):
+        bs.append_message(label, np.asarray(cols, dtype=np.uint8))
+    bs.finish_challenge(CHALLENGE_DST)
+
+    base = np.frombuffer(bs.base_state, dtype=np.uint8)[:, None]
+    s_hi, s_lo = _bytes_to_lanes_np(np.broadcast_to(base, (200, n)).copy())
+    masks = [_bytes_to_lanes_np(b) for b in bs.blocks]
+    m_hi = jnp.asarray(np.stack([m[0] for m in masks]))
+    m_lo = jnp.asarray(np.stack([m[1] for m in masks]))
+    hi, lo = _absorb_permute_chain(
+        jnp.asarray(s_hi), jnp.asarray(s_lo), m_hi, m_lo
+    )
+    lanes = dev_keccak.state_to_lanes((hi, lo))  # [n, 25] uint64
+    le = lanes[:, :8].copy().view(np.uint8).reshape(n, 64)
+    return le

@@ -1,0 +1,130 @@
+"""Batched Keccak-f[1600] on the device (JAX int32 lanes).
+
+SURVEY.md §7 hard part 4: challenge-hash throughput at 1M proofs/sec.
+The host plane derives Fiat-Shamir challenges on a C++ thread pool
+(``native/merlin.cpp``); this kernel is the device alternative — the
+permutation batched over proofs, so the batch axis rides the TPU vector
+lanes exactly like the limb arithmetic in :mod:`cpzk_tpu.ops.limbs`.
+
+TPU has no 64-bit integer lanes, so each Keccak lane is an (hi, lo)
+int32 pair and the state is two ``[25, n]`` int32 arrays.  64-bit XOR is
+two 32-bit XORs; rotl64 decomposes into cross-word shifts on the pair
+(a rotation by exactly 32 swaps the words).  Everything below is pure
+jnp with a Python-unrolled 24-round loop — ~3.8k vector ops per
+permutation, fully data-independent, so one ``jit`` covers any batch.
+
+Bit-exact vs the host oracle (:mod:`cpzk_tpu.core.keccak`, itself
+validated against hashlib SHA3) by ``tests/test_ops_keccak.py``.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+from jax import lax
+
+from ..core import keccak as host_keccak
+
+_RHO = host_keccak._RHO
+_RC = host_keccak._ROUND_CONSTANTS
+
+State = tuple[jnp.ndarray, jnp.ndarray]  # (hi, lo) each [25, ...] int32
+
+
+def _rotl(hi: jnp.ndarray, lo: jnp.ndarray, n: int) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """64-bit rotate-left by a static n on (hi, lo) int32 pairs.
+
+    Logical >> on int32 needs a mask after the arithmetic shift; << is
+    exact mod 2^32.  n == 32 is a pure word swap; n < 32 shifts within
+    words with cross-word carries, n > 32 is swap + shift.
+    """
+    n %= 64
+    if n == 0:
+        return hi, lo
+    if n == 32:
+        return lo, hi
+    if n > 32:
+        hi, lo = lo, hi
+        n -= 32
+    # 0 < n < 32: out_hi = hi << n | lo >>> (32-n), out_lo = lo << n | hi >>> (32-n)
+    m = (1 << n) - 1  # mask for the (32-n) logical right shift result
+    rhi = (hi << n) | ((lo >> (32 - n)) & m)
+    rlo = (lo << n) | ((hi >> (32 - n)) & m)
+    return rhi, rlo
+
+
+_RC_PAIRS = np.array(
+    [[(rc >> 32) & 0xFFFFFFFF, rc & 0xFFFFFFFF] for rc in _RC], dtype=np.uint32
+).astype(np.int32)  # [24, 2] (hi, lo)
+
+
+def _round(ahi: list, alo: list, rc_hi, rc_lo) -> tuple[list, list]:
+    """One Keccak round on unstacked (hi, lo) lane lists."""
+    # theta
+    chi = [ahi[x] ^ ahi[x + 5] ^ ahi[x + 10] ^ ahi[x + 15] ^ ahi[x + 20] for x in range(5)]
+    clo = [alo[x] ^ alo[x + 5] ^ alo[x + 10] ^ alo[x + 15] ^ alo[x + 20] for x in range(5)]
+    for x in range(5):
+        rh, rl = _rotl(chi[(x + 1) % 5], clo[(x + 1) % 5], 1)
+        dh, dl = chi[(x + 4) % 5] ^ rh, clo[(x + 4) % 5] ^ rl
+        for y in range(5):
+            ahi[x + 5 * y] = ahi[x + 5 * y] ^ dh
+            alo[x + 5 * y] = alo[x + 5 * y] ^ dl
+    # rho + pi
+    bhi: list = [None] * 25
+    blo: list = [None] * 25
+    for x in range(5):
+        for y in range(5):
+            src = x + 5 * y
+            dst = y + 5 * ((2 * x + 3 * y) % 5)
+            bhi[dst], blo[dst] = _rotl(ahi[src], alo[src], _RHO[src])
+    # chi
+    for y in range(5):
+        row_h = bhi[5 * y : 5 * y + 5]
+        row_l = blo[5 * y : 5 * y + 5]
+        for x in range(5):
+            ahi[x + 5 * y] = row_h[x] ^ (~row_h[(x + 1) % 5] & row_h[(x + 2) % 5])
+            alo[x + 5 * y] = row_l[x] ^ (~row_l[(x + 1) % 5] & row_l[(x + 2) % 5])
+    # iota
+    ahi[0] = ahi[0] ^ rc_hi
+    alo[0] = alo[0] ^ rc_lo
+    return ahi, alo
+
+
+def keccak_f1600(state: State) -> State:
+    """One Keccak-f[1600] permutation over a batched state.
+
+    ``state`` is (hi, lo) int32 arrays shaped [25, ...batch], lane index
+    x + 5y matching the host oracle.  The 24 rounds run under a
+    ``lax.scan`` over the round constants — a fully-unrolled permutation
+    is ~12k tiny HLO ops and sends XLA compile time (and memory) through
+    the roof; the scanned body is ~500 ops compiled once.
+    """
+
+    def body(carry, rc):
+        hi, lo = carry
+        ahi, alo = _round([hi[i] for i in range(25)], [lo[i] for i in range(25)],
+                          rc[0], rc[1])
+        return (jnp.stack(ahi, axis=0), jnp.stack(alo, axis=0)), None
+
+    (hi, lo), _ = lax.scan(body, state, jnp.asarray(_RC_PAIRS))
+    return hi, lo
+
+
+# ---------------------------------------------------------------------------
+# host <-> device state conversion (for tests and absorb phases)
+# ---------------------------------------------------------------------------
+
+def lanes_to_state(lanes: np.ndarray) -> State:
+    """[n, 25] uint64 lane values -> device (hi, lo) [25, n] int32."""
+    lanes = np.asarray(lanes, dtype=np.uint64).T  # [25, n]
+    hi = (lanes >> np.uint64(32)).astype(np.uint32).astype(np.int32)
+    lo = (lanes & np.uint64(0xFFFFFFFF)).astype(np.uint32).astype(np.int32)
+    return jnp.asarray(hi), jnp.asarray(lo)
+
+
+def state_to_lanes(state: State) -> np.ndarray:
+    """Device (hi, lo) [25, n] -> [n, 25] uint64 lane values."""
+    hi = np.asarray(state[0]).astype(np.uint32).astype(np.uint64)
+    lo = np.asarray(state[1]).astype(np.uint32).astype(np.uint64)
+    return ((hi << np.uint64(32)) | lo).T
