@@ -108,3 +108,27 @@ def test_device_challenge_odd_context_length():
                             ("y1", b"y1"), ("y2", b"y2"), ("r1", b"r1"), ("r2", b"r2")):
             t.append_message(label, pts[name][i].tobytes())
         assert got[i].tobytes() == t.challenge_bytes(CHALLENGE_DST, 64), i
+
+
+def test_device_challenge_path_in_derive_batch(monkeypatch):
+    """CPZK_DEVICE_CHALLENGES=1 routes derive_challenges_batch through the
+    device pipeline with identical Scalars (uniform, empty, and ragged
+    context shapes; ragged falls back)."""
+    import secrets
+
+    from cpzk_tpu.core.transcript import derive_challenges_batch
+
+    n = 6
+    mk = lambda: [secrets.token_bytes(32) for _ in range(n)]
+    cols = [mk() for _ in range(6)]
+    for contexts in (
+        [None] * n,
+        [b"X" * 32] * n,
+        [b""] * n,
+        [secrets.token_bytes(i + 1) for i in range(n)],  # ragged -> fallback
+    ):
+        expected = derive_challenges_batch(contexts, *cols)
+        monkeypatch.setenv("CPZK_DEVICE_CHALLENGES", "1")
+        got = derive_challenges_batch(contexts, *cols)
+        monkeypatch.delenv("CPZK_DEVICE_CHALLENGES")
+        assert [s.value for s in got] == [s.value for s in expected]
