@@ -170,6 +170,28 @@ def bench_challenge(n: int, iters: int) -> None:
             device_once()
             best = min(best, time.perf_counter() - t0)
         emit("challenge_device", n / best / 1e3, "kchal/s", n=n)
+
+        # fused variant: challenge bytes reduced to scalar limbs ON device
+        # (what an all-device challenges->RLC pipeline consumes directly)
+        import jax
+
+        from cpzk_tpu.ops import sclimbs
+
+        reduce_fn = jax.jit(sclimbs.reduce_wide)
+
+        def fused_once():
+            chal = derive_challenges_device(None, *cols[1:])
+            return jax.block_until_ready(
+                reduce_fn(sclimbs.bytes_wide_to_limbs(chal))
+            )
+
+        fused_once()
+        best = float("inf")
+        for _ in range(iters):
+            t0 = time.perf_counter()
+            fused_once()
+            best = min(best, time.perf_counter() - t0)
+        emit("challenge_device_reduced", n / best / 1e3, "kchal/s", n=n)
     except Exception as e:
         emit("challenge_device", 0.0, "kchal/s", n=n, error=str(e)[:200])
 

@@ -59,6 +59,105 @@ def _windows(values: list[int], pad: int) -> jnp.ndarray:
     return jnp.asarray(curve.scalars_to_windows(vals))
 
 
+@jax.jit
+def _rlc_products(n_arr, al, cl, sl, bl):
+    """Device RLC scalar prep (CPZK_DEVICE_RLC=1): from alpha/challenge/
+    response limbs (zero-padded past the true row count), derive the four
+    window columns of the combined check — the per-row Python big-int
+    products this replaces are the host bottleneck at 1M-row scale
+    (PROFILE.md §1; ops/sclimbs.py module docstring).
+
+    Inputs are [20, pad] limb arrays; ``n_arr`` is the TRACED row count,
+    so the jit cache keys on the padded shape only.  The correction
+    scalars land in column ``n`` via a lane mask (matching the host
+    path's point layout: rows, then the G/H correction row, then
+    identity padding — the pre-splice padding lanes hold zero scalars).
+    Returns four [64, pad] window arrays for a, a*c, b*a, b*a*c.
+    """
+    from . import sclimbs as sc
+
+    ac = sc.mul(al, cl)
+    ba = sc.mul(bl, al)
+    bac = sc.mul(bl, ac)
+    sum_as = sc.sum_mod_l(sc.mul(al, sl))            # [20, 1]
+    corr0 = sc.neg(sum_as)
+    corr1 = sc.neg(sc.mul(bl, sum_as))
+
+    lane = jnp.arange(al.shape[-1])[None, :]  # [1, pad]
+
+    def col(body, corr):
+        spliced = jnp.where(lane == n_arr, corr, body)
+        return sc.to_windows(spliced)
+
+    zero = jnp.zeros_like(corr0)
+    return (
+        col(al, corr0), col(ac, corr1), col(ba, zero), col(bac, zero)
+    )
+
+
+def _marshal_scalar_limbs(rows: list[BatchRow], beta: Scalar, pad: int):
+    from . import sclimbs as sc
+
+    n = len(rows)
+    zeros = [0] * (pad - n)
+    al = jnp.asarray(sc.ints_to_limbs([r.alpha.value for r in rows] + zeros))
+    cl = jnp.asarray(sc.ints_to_limbs([r.c.value for r in rows] + zeros))
+    sl = jnp.asarray(sc.ints_to_limbs([r.s.value for r in rows] + zeros))
+    bl = jnp.asarray(sc.ints_to_limbs([beta.value]))
+    return al, cl, sl, bl
+
+
+def _rlc_windows_device(rows: list[BatchRow], beta: Scalar, pad: int):
+    """Device window columns for the per-row combined kernel."""
+    al, cl, sl, bl = _marshal_scalar_limbs(rows, beta, pad)
+    return _rlc_products(jnp.int32(len(rows)), al, cl, sl, bl)
+
+
+@jax.jit
+def _rlc_scalar_groups(al, cl, sl, bl):
+    """Products + corrections for the Pippenger term layout (no splice:
+    the caller concatenates the groups eagerly)."""
+    from . import sclimbs as sc
+
+    ac = sc.mul(al, cl)
+    ba = sc.mul(bl, al)
+    bac = sc.mul(bl, ac)
+    sum_as = sc.sum_mod_l(sc.mul(al, sl))
+    return ac, ba, bac, sc.neg(sum_as), sc.neg(sc.mul(bl, sum_as))
+
+
+@partial(jax.jit, static_argnums=(0,))
+def _signed_digits_jit(c, limbs_arr):
+    from . import sclimbs as sc
+
+    return sc.to_signed_digits(limbs_arr, c)
+
+
+def _pippenger_digits_device(
+    rows: list[BatchRow], beta: Scalar, m: int, c: int
+) -> jnp.ndarray:
+    """[K, m] signed digits for the 4n+2-term MSM — scalar products and
+    the digit recode both on device (CPZK_DEVICE_RLC=1 large-batch path).
+
+    Term order matches ``_combined_pippenger``'s point layout:
+    a(n) | ac(n) | ba(n) | bac(n) | corr_G | corr_H | zeros(pad).  The
+    group concatenation happens eagerly (outside jit), so the two jitted
+    stages key on the pow2-padded row count and the term count only.
+    """
+    n = len(rows)
+    pad = _pad_pow2(n)
+    al, cl, sl, bl = _marshal_scalar_limbs(rows, beta, pad)
+    ac, ba, bac, corr0, corr1 = _rlc_scalar_groups(al, cl, sl, bl)
+    from . import sclimbs as sc
+
+    zeros = jnp.zeros((sc.NLIMBS, m - 4 * n - 2), dtype=jnp.int32)
+    all_scalars = jnp.concatenate(
+        [al[:, :n], ac[:, :n], ba[:, :n], bac[:, :n], corr0, corr1, zeros],
+        axis=-1,
+    )
+    return _signed_digits_jit(c, all_scalars)
+
+
 @partial(jax.jit, static_argnums=(0,))
 def _each_shared(n_pad, g, h, y1, y2, r1, r2, ws, wc):
     del n_pad  # static cache key only
@@ -132,17 +231,10 @@ class TpuBackend(VerifierBackend):
 
     def verify_combined(self, rows: list[BatchRow], beta: Scalar) -> bool:
         n = len(rows)
-        b = beta.value
-        a = [r.alpha.value for r in rows]
-        c = [r.c.value for r in rows]
-        s = [r.s.value for r in rows]
-        ac = [x * y % L for x, y in zip(a, c)]
-        ba = [b * x % L for x in a]
-        bac = [b * x % L for x in ac]
-        sum_as = sum(x * y for x, y in zip(a, s)) % L
+        device_rlc = os.environ.get("CPZK_DEVICE_RLC") == "1"
 
         if n >= PIPPENGER_MIN_ROWS:
-            return self._combined_pippenger(rows, a, ac, ba, bac, b, sum_as)
+            return self._combined_pippenger(rows, beta, device_rlc)
 
         # correction row: G in slot r1 with -sum(a s), H in slot y1 with
         # -b sum(a s); identity in the other two slots.
@@ -152,30 +244,37 @@ class TpuBackend(VerifierBackend):
         y1 = _points_soa([r.y1.point for r in rows] + [h], pad)
         r2 = _points_soa([r.r2.point for r in rows], pad)
         y2 = _points_soa([r.y2.point for r in rows], pad)
-        w_a = _windows(a + [(L - sum_as) % L], pad)
-        w_ac = _windows(ac + [(L - b * sum_as % L) % L], pad)
-        w_ba = _windows(ba, pad)
-        w_bac = _windows(bac, pad)
+        if device_rlc:
+            w_a, w_ac, w_ba, w_bac = _rlc_windows_device(rows, beta, pad)
+        else:
+            b = beta.value
+            a = [r.alpha.value for r in rows]
+            c = [r.c.value for r in rows]
+            s = [r.s.value for r in rows]
+            ac = [x * y % L for x, y in zip(a, c)]
+            ba = [b * x % L for x in a]
+            bac = [b * x % L for x in ac]
+            sum_as = sum(x * y for x, y in zip(a, s)) % L
+            w_a = _windows(a + [(L - sum_as) % L], pad)
+            w_ac = _windows(ac + [(L - b * sum_as % L) % L], pad)
+            w_ba = _windows(ba, pad)
+            w_bac = _windows(bac, pad)
 
         ok = _combined(pad, r1, y1, r2, y2, w_a, w_ac, w_ba, w_bac)
         return bool(ok)
 
     def _combined_pippenger(
-        self,
-        rows: list[BatchRow],
-        a: list[int],
-        ac: list[int],
-        ba: list[int],
-        bac: list[int],
-        b: int,
-        sum_as: int,
+        self, rows: list[BatchRow], beta: Scalar, device_rlc: bool
     ) -> bool:
         """One MSM over all 4n+2 (point, scalar) terms == identity.
 
         The row count (not the term count) is padded to a power of two, so
         the jit cache stays small while padding waste stays ~0% — padding
         the 4n+2 terms directly would double device work at power-of-two
-        batch sizes, the common full-batch serving case.
+        batch sizes, the common full-batch serving case.  With
+        CPZK_DEVICE_RLC=1 the per-term scalars and their signed digits
+        come from the device scalar plane (``_pippenger_digits_device``)
+        instead of per-row host big-int products.
         """
         points = (
             [r.r1.point for r in rows]
@@ -184,13 +283,26 @@ class TpuBackend(VerifierBackend):
             + [r.y2.point for r in rows]
             + [rows[0].g.point, rows[0].h.point]
         )
-        scalars = a + ac + ba + bac + [(L - sum_as) % L, (L - b * sum_as % L) % L]
         m = 4 * _pad_pow2(len(rows)) + 2
         c = msm.pick_window(m)
         pts = _points_soa(points, m)
-        digits = jnp.asarray(
-            msm.scalars_to_signed_digits(scalars + [0] * (m - len(scalars)), c)
-        )
+        if device_rlc:
+            digits = _pippenger_digits_device(rows, beta, m, c)
+        else:
+            b = beta.value
+            a = [r.alpha.value for r in rows]
+            ch = [r.c.value for r in rows]
+            s = [r.s.value for r in rows]
+            ac = [x * y % L for x, y in zip(a, ch)]
+            ba = [b * x % L for x in a]
+            bac = [b * x % L for x in ac]
+            sum_as = sum(x * y for x, y in zip(a, s)) % L
+            scalars = a + ac + ba + bac + [
+                (L - sum_as) % L, (L - b * sum_as % L) % L,
+            ]
+            digits = jnp.asarray(
+                msm.scalars_to_signed_digits(scalars + [0] * (m - len(scalars)), c)
+            )
         if self._sharded_msm is not None:
             return bool(self._sharded_msm(pts, digits, c))
         return bool(_msm_identity(c, pts, digits))

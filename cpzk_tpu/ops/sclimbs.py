@@ -1,0 +1,292 @@
+"""Batched scalar-field arithmetic mod l on the device (Barrett).
+
+l = 2^252 + 27742317777372353535851937790883648493 has a 125-bit "tail",
+so the cheap fold trick the GF(2^255-19) plane uses (2^260 = 608 mod p,
+``ops/limbs.py``) does not exist here — reduction is a textbook Barrett
+with the precomputed reciprocal mu = floor(b^(2K) / l) at limb base
+b = 2^13, K = 20 limbs.
+
+Why this module exists (SURVEY.md §7 / the 1M proofs/s budget): the RLC
+combined check needs per-row scalar products a*c, b*a, b*a*c, the inner
+product sum(a*s) mod l, and signed-digit/window decomposition.  On the
+host those are Python big-int loops — microseconds per row, i.e.
+*seconds* per 1M-row batch; here they are vectorized device ops over
+``[20, n]`` int32 arrays in the same limb-major layout as the rest of
+the data plane, wired into ``TpuBackend`` behind ``CPZK_DEVICE_RLC=1``.
+``reduce_wide``/``bytes_wide_to_limbs`` additionally provide the
+64-byte wide challenge reduction on device — benchmarked as the fused
+challenges->scalars alternative (``bench_kernels --only challenge``);
+the serving path currently resolves challenges to host Scalars, whose
+``int.from_bytes % L`` is cheap at per-RPC granularity.
+
+Representation: values < 2^260 as 20x13-bit limbs (leading limb axis),
+same conversions as :mod:`cpzk_tpu.ops.limbs`.  All outputs are fully
+reduced (< l) — unlike the field plane's loose carried form, scalar
+consumers (digit/window decomposition) need canonical values.
+
+Bit-exact vs :mod:`cpzk_tpu.core.scalars` by tests/test_ops_sclimbs.py.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..core.scalars import L
+
+NLIMBS = 20          # limbs for one reduced scalar (260 bits >= 253)
+LIMB_BITS = 13
+MASK = (1 << LIMB_BITS) - 1
+K = NLIMBS
+
+#: Barrett reciprocal mu = floor(b^(2K) / l), 41 limbs (b^(2K) = 2^520).
+_MU = (1 << (2 * K * LIMB_BITS)) // L
+
+
+def _int_to_limbs_np(v: int, width: int) -> np.ndarray:
+    out = np.empty(width, dtype=np.int32)
+    for i in range(width):
+        out[i] = v & MASK
+        v >>= LIMB_BITS
+    if v:
+        raise ValueError("value too wide")
+    return out
+
+
+_L_LIMBS = _int_to_limbs_np(L, NLIMBS)           # [20]
+_MU_LIMBS = _int_to_limbs_np(_MU, 2 * K + 1)     # [41]
+
+
+def _carry_strip(x: jnp.ndarray, width: int) -> jnp.ndarray:
+    """Carry-normalize a non-negative limb vector to EXACTLY canonical
+    ``width`` limbs in [0, 2^13) (carries beyond ``width`` must be zero
+    by the caller's value bound).
+
+    Two parallel widen rounds shrink limbs from < 2^31 to <= 2^13 + 1;
+    a final sequential chain guarantees canonical form — parallel rounds
+    alone can ripple 0x1FFF runs one limb per round and never settle,
+    and the comparisons downstream (``_ge``) require canonical limbs."""
+    pad_cfg = [(0, 0)] * (x.ndim - 1)
+    x = jnp.pad(x, [(0, max(0, width - x.shape[0]))] + pad_cfg)[:width]
+    for _ in range(2):
+        lo = x & MASK
+        hi = x >> LIMB_BITS
+        x = lo + jnp.pad(hi[:-1], [(1, 0)] + pad_cfg)
+    out = []
+    carry = jnp.zeros_like(x[0])
+    for i in range(width):
+        t = x[i] + carry
+        carry = t >> LIMB_BITS
+        out.append(t & MASK)
+    return jnp.stack(out, axis=0)
+
+
+def _mul_raw(a: jnp.ndarray, b: jnp.ndarray, na: int, nb: int) -> jnp.ndarray:
+    """Schoolbook [na, ...] x [nb, ...] -> carried [na+nb, ...] product.
+
+    Anti-diagonal sums stay < max(na, nb) * 2^26 < 2^31 for na, nb <= 41.
+    """
+    batch = jnp.broadcast_shapes(a.shape[1:], b.shape[1:])
+    a = jnp.broadcast_to(a, (na,) + batch)
+    b = jnp.broadcast_to(b, (nb,) + batch)
+    outer = a[:, None] * b[None, :]  # [na, nb, ...]
+    pad_cfg = [(0, 0)] * len(batch)
+    width = na + nb - 1
+    outer = jnp.pad(outer, [(0, 0), (0, na)] + pad_cfg)  # [na, nb+na, ...]
+    flat = outer.reshape((na * (nb + na),) + batch)
+    flat = flat[: na * width]
+    prod = flat.reshape((na, width) + batch).sum(axis=0)
+    return _carry_strip(prod, na + nb)
+
+
+def _ge(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Limbwise lexicographic a >= b for canonical limb vectors -> [...]."""
+    gt = a > b
+    lt = a < b
+    # most-significant difference decides: scan from the top limb down
+    result = jnp.zeros(a.shape[1:], dtype=jnp.bool_)
+    decided = jnp.zeros(a.shape[1:], dtype=jnp.bool_)
+    for i in range(a.shape[0] - 1, -1, -1):
+        result = jnp.where(~decided & gt[i], True, result)
+        decided = decided | gt[i] | lt[i]
+    return result | ~decided  # equal -> >= holds
+
+
+def _sub_nonneg(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """a - b for a >= b, canonical limbs in/out (sequential borrow)."""
+    width = a.shape[0]
+    out = []
+    borrow = jnp.zeros_like(a[0])
+    for i in range(width):
+        t = a[i] - (b[i] if i < b.shape[0] else 0) - borrow
+        borrow = (t < 0).astype(jnp.int32)
+        out.append(t + borrow * (1 << LIMB_BITS))
+    return jnp.stack(out, axis=0)
+
+
+def _cond_sub_l(x: jnp.ndarray) -> jnp.ndarray:
+    """x - l when x >= l (x < 2l, canonical [20, ...] limbs)."""
+    lv = jnp.asarray(_L_LIMBS).reshape((NLIMBS,) + (1,) * (x.ndim - 1))
+    lv = jnp.broadcast_to(lv, x.shape)
+    need = _ge(x, lv)
+    return jnp.where(need, _sub_nonneg(x, lv), x)
+
+
+def reduce_wide(x: jnp.ndarray) -> jnp.ndarray:
+    """Barrett: [W, ...] limbs (W <= 2K, value < b^(2K)) -> canonical
+    [20, ...] limbs of x mod l.
+
+    q_hat = floor( floor(x / b^(K-1)) * mu / b^(K+1) );  r = x - q_hat*l.
+    The classic bound gives r < 3l, so two conditional subtractions
+    finish; we spend a third for slack on the truncated-product path.
+    """
+    batch = x.shape[1:]
+    pad_cfg = [(0, 0)] * len(batch)
+    w = x.shape[0]
+    if w < 2 * K:
+        x = jnp.pad(x, [(0, 2 * K - w)] + pad_cfg)
+    x_hi = x[K - 1 :]  # floor(x / b^(K-1)), K+1 limbs
+    mu = jnp.asarray(_MU_LIMBS).reshape((2 * K + 1,) + (1,) * len(batch))
+    prod = _mul_raw(x_hi, mu, K + 1, 2 * K + 1)      # [3K+2, ...]
+    q_hat = prod[K + 1 : 2 * K + 2]                   # floor(./b^(K+1)), K+1 limbs
+    lv = jnp.asarray(_L_LIMBS).reshape((NLIMBS,) + (1,) * len(batch))
+    ql = _mul_raw(q_hat, lv, K + 1, NLIMBS)           # [2K+1, ...]
+    # r = x - q_hat*l with 0 <= r < 3l < 2^254: the value fits 20 limbs
+    # (260 bits), so subtracting in a (K+2)-limb window cancels the higher
+    # limbs exactly and limbs K, K+1 of the result are zero
+    r = _sub_nonneg(x[: K + 2], ql[: K + 2])[:K]
+    for _ in range(2):  # r < 3l: at most two subtractions
+        r = _cond_sub_l(r)
+    return r
+
+
+def mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Canonical [20, ...] x [20, ...] -> canonical [20, ...] mod l."""
+    return reduce_wide(_mul_raw(a, b, NLIMBS, NLIMBS))
+
+
+def add(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    # sum < 2l < 2^254 fits 20 limbs; one conditional subtract finishes
+    return _cond_sub_l(_carry_strip(a + b, NLIMBS))
+
+
+def neg(a: jnp.ndarray) -> jnp.ndarray:
+    """l - a (canonical in/out; maps 0 -> 0 via the conditional subtract)."""
+    lv = jnp.asarray(_L_LIMBS).reshape((NLIMBS,) + (1,) * (a.ndim - 1))
+    lv = jnp.broadcast_to(lv, a.shape)
+    return _cond_sub_l(_sub_nonneg(lv, a))
+
+
+def sum_mod_l(a: jnp.ndarray) -> jnp.ndarray:
+    """Sum canonical [20, n] scalars over the batch axis -> [20, 1].
+
+    A single jnp.sum would overflow int32 past n = 2^18 (limb sums reach
+    n * 2^13), so the reduction is hierarchical: chunks of 2^15 columns
+    sum exactly (< 2^28), each chunk partial carries to canonical form
+    (limbs < 2^13 again), and the n/2^15 partials sum once more — safe up
+    to n = 2^33, far past any addressable batch — before one Barrett
+    reduction."""
+    chunk = 1 << 15
+    n = a.shape[-1]
+    if n <= chunk:
+        s = jnp.sum(a, axis=-1, keepdims=True)
+    else:
+        pad = (-n) % chunk
+        ap = jnp.pad(a, [(0, 0), (0, pad)])
+        parts = jnp.sum(ap.reshape(NLIMBS, -1, chunk), axis=-1)  # [20, n/2^15]
+        parts = _carry_strip(parts, 2 * K)                        # canonical
+        s = jnp.sum(parts, axis=-1, keepdims=True)
+    s = _carry_strip(s, 2 * K)
+    return reduce_wide(s)
+
+
+def to_signed_digits(a: jnp.ndarray, c: int) -> jnp.ndarray:
+    """Canonical [20, ...] limbs -> [K, ...] signed c-bit digits (LSB
+    window first), device twin of
+    :func:`cpzk_tpu.ops.msm.scalars_to_signed_digits`.
+
+    Unsigned c-bit windows come from the bit expansion; the borrow recode
+    (digit in [-2^(c-1), 2^(c-1))) is a K-step ``lax.scan`` carry chain —
+    K <= 64, trivially small next to the MSM it feeds.
+    """
+    from jax import lax
+
+    from .msm import num_windows
+
+    k = num_windows(c)
+    batch = a.shape[1:]
+    shifts = jnp.arange(LIMB_BITS, dtype=jnp.int32).reshape(
+        (1, LIMB_BITS) + (1,) * len(batch)
+    )
+    bits = ((a[:, None] >> shifts) & 1).reshape((NLIMBS * LIMB_BITS,) + batch)
+    pad_cfg = [(0, 0)] * len(batch)
+    bits = jnp.pad(bits, [(0, k * c - NLIMBS * LIMB_BITS)] + pad_cfg)
+    w = (1 << jnp.arange(c, dtype=jnp.int32)).reshape((1, c) + (1,) * len(batch))
+    u = jnp.sum(bits.reshape((k, c) + batch) * w, axis=1)  # [K, ...] unsigned
+    half = 1 << (c - 1)
+
+    def step(carry, uw):
+        t = uw + carry
+        wrap = (t >= half).astype(jnp.int32)
+        return wrap, t - wrap * (1 << c)
+
+    _, digits = lax.scan(step, jnp.zeros(batch, dtype=jnp.int32), u)
+    return digits
+
+
+def to_windows(a: jnp.ndarray) -> jnp.ndarray:
+    """Canonical [20, ...] scalar limbs -> [64, ...] 4-bit windows,
+    most-significant window first (the layout ``ops.curve`` ladders eat).
+
+    Device twin of :func:`cpzk_tpu.ops.curve.scalars_to_windows`: expands
+    the 13-bit limbs to a [260, ...] bit array and regroups nibbles —
+    window bits can straddle limb boundaries, so bit granularity is the
+    simple uniform formulation (~20 vector ops, no gathers).
+    """
+    batch = a.shape[1:]
+    shifts = jnp.arange(LIMB_BITS, dtype=jnp.int32).reshape(
+        (1, LIMB_BITS) + (1,) * len(batch)
+    )
+    bits = (a[:, None] >> shifts) & 1                   # [20, 13, ...]
+    bits = bits.reshape((NLIMBS * LIMB_BITS,) + batch)  # [260, ...]
+    bits = bits[:256]                                   # scalars < 2^253
+    w = jnp.asarray([1, 2, 4, 8], dtype=jnp.int32).reshape(
+        (1, 4) + (1,) * len(batch)
+    )
+    wins = jnp.sum(bits.reshape((64, 4) + batch) * w, axis=1)  # LSB first
+    return wins[::-1]                                   # MSB first
+
+
+# -- host conversions (shared layout with ops.limbs) ------------------------
+
+def ints_to_limbs(values: list[int]) -> np.ndarray:
+    """[n] python ints (mod l) -> [20, n] int32 canonical limbs."""
+    return _ints(values)
+
+
+def _ints(values: list[int]) -> np.ndarray:
+    blob = b"".join((v % L).to_bytes(33, "little") for v in values)
+    raw = np.frombuffer(blob, dtype=np.uint8).reshape(len(values), 33)
+    bits = np.unpackbits(raw, axis=1, bitorder="little")[:, : NLIMBS * LIMB_BITS]
+    weights = 1 << np.arange(LIMB_BITS, dtype=np.int32)
+    rows = bits.reshape(len(values), NLIMBS, LIMB_BITS).astype(np.int32) @ weights
+    return np.ascontiguousarray(rows.T)
+
+
+def bytes_wide_to_limbs(blob: np.ndarray) -> np.ndarray:
+    """[n, 64] uint8 (wide challenge bytes) -> [40, n] int32 limbs."""
+    raw = np.asarray(blob, dtype=np.uint8).reshape(-1, 64)
+    bits = np.unpackbits(raw, axis=1, bitorder="little")
+    bits = np.pad(bits, [(0, 0), (0, 40 * LIMB_BITS - 512)])
+    weights = 1 << np.arange(LIMB_BITS, dtype=np.int32)
+    rows = bits.reshape(len(raw), 40, LIMB_BITS).astype(np.int32) @ weights
+    return np.ascontiguousarray(rows.T)
+
+
+def limbs_to_ints(limbs: np.ndarray) -> list[int]:
+    arr = np.asarray(limbs).reshape(NLIMBS, -1)
+    return [
+        sum(int(arr[i, j]) << (LIMB_BITS * i) for i in range(NLIMBS))
+        for j in range(arr.shape[1])
+    ]

@@ -1,0 +1,189 @@
+"""Device scalar-field (mod l) arithmetic vs the host oracle: Barrett
+reduction, products, sums, wide challenge reduction — bit-exact."""
+
+import secrets
+
+import numpy as np
+
+import jax
+
+from cpzk_tpu.core.scalars import L
+from cpzk_tpu.ops import sclimbs as m
+
+
+def rand_scalars(n):
+    return [secrets.randbelow(L) for _ in range(n)]
+
+
+def test_mul_matches_host():
+    n = 33
+    xs, ys = rand_scalars(n), rand_scalars(n)
+    # adversarial edges
+    xs[:4] = [0, 1, L - 1, L - 1]
+    ys[:4] = [L - 1, L - 1, L - 1, 1]
+    out = m.limbs_to_ints(jax.jit(m.mul)(m.ints_to_limbs(xs), m.ints_to_limbs(ys)))
+    assert out == [x * y % L for x, y in zip(xs, ys)]
+
+
+def test_add_matches_host():
+    n = 17
+    xs, ys = rand_scalars(n), rand_scalars(n)
+    xs[0], ys[0] = L - 1, L - 1
+    out = m.limbs_to_ints(jax.jit(m.add)(m.ints_to_limbs(xs), m.ints_to_limbs(ys)))
+    assert out == [(x + y) % L for x, y in zip(xs, ys)]
+
+
+def test_wide_reduction_matches_host():
+    n = 9
+    blobs = [secrets.token_bytes(64) for _ in range(n)]
+    blobs[0] = b"\xff" * 64   # max 512-bit value
+    blobs[1] = bytes(64)      # zero
+    cols = np.frombuffer(b"".join(blobs), dtype=np.uint8).reshape(n, 64)
+    out = m.limbs_to_ints(jax.jit(m.reduce_wide)(m.bytes_wide_to_limbs(cols)))
+    assert out == [int.from_bytes(b, "little") % L for b in blobs]
+
+
+def test_sum_mod_l_matches_host():
+    for n in (1, 7, 1024):
+        xs = rand_scalars(n)
+        got = m.limbs_to_ints(m.sum_mod_l(m.ints_to_limbs(xs)))[0]
+        assert got == sum(xs) % L, n
+
+
+def test_mul_chain_stays_canonical():
+    """Outputs feed back as inputs (canonical-in/canonical-out contract)."""
+    xs = rand_scalars(5)
+    a = m.ints_to_limbs(xs)
+    acc = a
+    exp = list(xs)
+    fn = jax.jit(m.mul)
+    for _ in range(4):
+        acc = fn(acc, a)
+        exp = [e * x % L for e, x in zip(exp, xs)]
+    assert m.limbs_to_ints(acc) == exp
+
+
+def test_to_windows_matches_host():
+    from cpzk_tpu.ops.curve import scalars_to_windows
+
+    xs = rand_scalars(21) + [0, 1, L - 1]
+    got = np.asarray(jax.jit(m.to_windows)(m.ints_to_limbs(xs)))
+    exp = scalars_to_windows(xs)
+    assert got.shape == exp.shape and (got == exp).all()
+
+
+def test_device_rlc_prep_end_to_end(monkeypatch):
+    """CPZK_DEVICE_RLC=1 routes the combined check's scalar prep through
+    the device plane with identical accept/reject decisions."""
+    from cpzk_tpu import BatchVerifier, Parameters, Prover, SecureRng, Transcript, Witness
+    from cpzk_tpu.core.ristretto import Ristretto255
+    from cpzk_tpu.ops.backend import TpuBackend
+
+    rng, params = SecureRng(), Parameters.new()
+    provers = [
+        Prover(params, Witness(Ristretto255.random_scalar(rng))) for _ in range(5)
+    ]
+    proofs = [p.prove_with_transcript(rng, Transcript()) for p in provers]
+
+    monkeypatch.setenv("CPZK_DEVICE_RLC", "1")
+    monkeypatch.setenv("CPZK_PIPPENGER_MIN", "9999")  # force the rowcombined path
+
+    # all-valid batch accepts via the device-prep combined fast path
+    bv = BatchVerifier(backend=TpuBackend())
+    for p, pf in zip(provers, proofs):
+        bv.add(params, p.statement, pf)
+    assert bv.verify(rng) == [None] * 5
+
+    # one bad row: combined fails, per-proof fallback flags index 5 only
+    bv = BatchVerifier(backend=TpuBackend())
+    for p, pf in zip(provers, proofs):
+        bv.add(params, p.statement, pf)
+    bv.add(params, provers[0].statement, proofs[1])
+    res = bv.verify(rng)
+    assert [r is None for r in res] == [True] * 5 + [False]
+
+
+def test_device_rlc_windows_match_host():
+    """The four device-derived window columns are bit-identical to the
+    host big-int products for the same rows and beta."""
+    import os
+
+    import numpy as np
+
+    from cpzk_tpu import Parameters, Prover, SecureRng, Transcript, Witness
+    from cpzk_tpu.core.ristretto import Ristretto255
+    from cpzk_tpu.ops.backend import _pad_pow2, _rlc_windows_device, _windows
+    from cpzk_tpu.protocol.batch import BatchVerifier
+
+    rng, params = SecureRng(), Parameters.new()
+    bv = BatchVerifier()
+    for _ in range(3):
+        p = Prover(params, Witness(Ristretto255.random_scalar(rng)))
+        bv.add(params, p.statement, p.prove_with_transcript(rng, Transcript()))
+    rows = bv._rows(rng)
+    beta = Ristretto255.random_scalar(rng)
+
+    n, b = len(rows), beta.value
+    pad = _pad_pow2(n + 1)
+    a = [r.alpha.value for r in rows]
+    c = [r.c.value for r in rows]
+    s = [r.s.value for r in rows]
+    ac = [x * y % L for x, y in zip(a, c)]
+    ba = [b * x % L for x in a]
+    bac = [b * x % L for x in ac]
+    sum_as = sum(x * y for x, y in zip(a, s)) % L
+    host_cols = (
+        _windows(a + [(L - sum_as) % L], pad),
+        _windows(ac + [(L - b * sum_as % L) % L], pad),
+        _windows(ba, pad),
+        _windows(bac, pad),
+    )
+    dev_cols = _rlc_windows_device(rows, beta, pad)
+    for hcol, dcol in zip(host_cols, dev_cols):
+        assert (np.asarray(hcol) == np.asarray(dcol)).all()
+
+
+def test_to_signed_digits_matches_host():
+    from cpzk_tpu.ops.msm import scalars_to_signed_digits
+
+    for c in (4, 8, 13, 16):
+        xs = rand_scalars(9) + [0, 1, L - 1]
+        got = np.asarray(m.to_signed_digits(m.ints_to_limbs(xs), c))
+        exp = scalars_to_signed_digits(xs, c)
+        assert got.shape == exp.shape and (got == exp).all(), c
+
+
+def test_device_rlc_pippenger_path(monkeypatch):
+    """CPZK_DEVICE_RLC=1 with the Pippenger branch engaged (n >= min):
+    same accept/reject, digits from the device scalar plane."""
+    from cpzk_tpu import BatchVerifier, Parameters, Prover, SecureRng, Transcript, Witness
+    from cpzk_tpu.core.ristretto import Ristretto255
+    from cpzk_tpu.ops.backend import TpuBackend
+
+    rng, params = SecureRng(), Parameters.new()
+    provers = [
+        Prover(params, Witness(Ristretto255.random_scalar(rng))) for _ in range(6)
+    ]
+    proofs = [p.prove_with_transcript(rng, Transcript()) for p in provers]
+
+    monkeypatch.setenv("CPZK_DEVICE_RLC", "1")
+    monkeypatch.setenv("CPZK_PIPPENGER_MIN", "2")  # force the MSM path
+    import importlib
+
+    import cpzk_tpu.ops.backend as backend_mod
+
+    importlib.reload(backend_mod)  # PIPPENGER_MIN_ROWS is read at import
+
+    bv = BatchVerifier(backend=backend_mod.TpuBackend())
+    for p, pf in zip(provers, proofs):
+        bv.add(params, p.statement, pf)
+    assert bv.verify(rng) == [None] * 6
+
+    bv = BatchVerifier(backend=backend_mod.TpuBackend())
+    for p, pf in zip(provers, proofs):
+        bv.add(params, p.statement, pf)
+    bv.add(params, provers[0].statement, proofs[1])
+    res = bv.verify(rng)
+    assert [r is None for r in res] == [True] * 6 + [False]
+
+    importlib.reload(backend_mod)  # restore default PIPPENGER_MIN_ROWS
