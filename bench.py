@@ -381,12 +381,17 @@ def _bench_e2e(inp: _Inputs) -> None:
         ok = once()
         best = min(best, time.perf_counter() - t0)
         assert ok
+    import jax
+
     rec = {
         "metric": "batch_verify_e2e_proofs_per_sec",
         "value": round(N / best, 1),
         "unit": "proofs/s",
         "vs_baseline": round(N / best / BASELINE, 3),
         "n": N,
+        # provenance: a CPU-backend smoke number must never read as a TPU
+        # result in the recorded artifact
+        "platform": jax.devices()[0].platform,
     }
     # overwrite: the artifact holds the latest run (sweep history lives in
     # the sweep's own output directory), so it cannot grow without bound
