@@ -1,0 +1,54 @@
+"""Collect hardware-sweep outputs (.hw/*.json) into a markdown table.
+
+The sweep (.hardware_sweep.sh pattern: poll the accelerator tunnel,
+run bench_kernels/bench.py tiers once it answers) drops one JSON-lines
+file per tier; this prints a PROFILE.md-ready table plus the raw lines,
+so a healed tunnel turns into a committed measurement section in one
+step.  Usage: python benches/collect_hw.py [dir]   (default .hw)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+
+def main() -> None:
+    d = sys.argv[1] if len(sys.argv) > 1 else ".hw"
+    if not os.path.isdir(d):
+        raise SystemExit(f"no sweep directory {d!r}")
+    rows = []
+    for name in sorted(os.listdir(d)):
+        if not name.endswith(".json"):
+            continue
+        with open(os.path.join(d, name), encoding="utf-8") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                rows.append((name, rec))
+    if not rows:
+        print("(no sweep records yet)")
+        return
+    print("| source | metric | value | unit | extra |")
+    print("|---|---|---|---|---|")
+    for name, rec in rows:
+        metric = rec.get("name") or rec.get("metric", "?")
+        extra = {
+            k: v
+            for k, v in rec.items()
+            if k not in ("name", "metric", "value", "unit")
+        }
+        print(
+            f"| {name} | {metric} | {rec.get('value')} | "
+            f"{rec.get('unit', '')} | {extra if extra else ''} |"
+        )
+
+
+if __name__ == "__main__":
+    main()
