@@ -179,3 +179,58 @@ def test_state_file_config_layering(tmp_path, monkeypatch):
     assert ServerConfig.from_env().state_file == ""
     monkeypatch.setenv("SERVER_STATE_FILE", "/tmp/a.json")
     assert ServerConfig.from_env().state_file == "/tmp/a.json"
+
+
+def test_restore_survives_mutated_snapshots(tmp_path):
+    """Random structural mutations of a valid snapshot must either load
+    cleanly or raise Error/ValueError-family exceptions — never crash the
+    process or accept garbage silently (the file is a trust boundary)."""
+    import random
+
+    rng, params = SecureRng(), Parameters.new()
+    path = str(tmp_path / "state.json")
+
+    async def build():
+        st = ServerState()
+        for i in range(2):
+            await st.register_user(UserData(f"u{i}", make_statement(rng, params), i))
+        await st.create_session("tok", "u0")
+        await st.snapshot(path)
+
+    run(build())
+    good = open(path).read()
+
+    r = random.Random(1234)
+    mutations = 0
+    for _ in range(120):
+        doc = bytearray(good.encode())
+        for _ in range(r.randint(1, 6)):
+            op = r.random()
+            i = r.randrange(len(doc))
+            if op < 0.4:
+                doc[i] = r.randrange(256)          # byte flip
+            elif op < 0.7:
+                del doc[i]                          # deletion
+            else:
+                doc.insert(i, r.randrange(32, 127))  # insertion
+        with open(path, "wb") as f:
+            f.write(doc)
+
+        async def attempt():
+            st = ServerState()
+            try:
+                await st.restore(path)
+            except Exception as e:
+                # JSON / schema / crypto rejections are the contract;
+                # anything else (segfault-class, assertion) would escape
+                from cpzk_tpu.errors import Error
+
+                assert isinstance(
+                    e, (Error, ValueError, KeyError, TypeError, UnicodeDecodeError)
+                ), type(e)
+                return False
+            return True
+
+        run(attempt())
+        mutations += 1
+    assert mutations == 120
