@@ -187,3 +187,42 @@ def test_device_rlc_pippenger_path(monkeypatch):
     assert [r is None for r in res] == [True] * 6 + [False]
 
     importlib.reload(backend_mod)  # restore default PIPPENGER_MIN_ROWS
+
+
+def test_device_rlc_composes_with_sharded_msm(monkeypatch):
+    """CPZK_DEVICE_RLC digits feed the mesh-sharded Pippenger check
+    unchanged (8 virtual devices via conftest's XLA_FLAGS)."""
+    import importlib
+
+    import jax
+
+    from cpzk_tpu import BatchVerifier, Parameters, Prover, SecureRng, Transcript, Witness
+    from cpzk_tpu.core.ristretto import Ristretto255
+
+    if jax.device_count() < 2:
+        import pytest
+
+        pytest.skip("needs the virtual multi-device CPU mesh")
+
+    monkeypatch.setenv("CPZK_DEVICE_RLC", "1")
+    monkeypatch.setenv("CPZK_PIPPENGER_MIN", "2")
+    import cpzk_tpu.ops.backend as backend_mod
+
+    importlib.reload(backend_mod)
+
+    rng, params = SecureRng(), Parameters.new()
+    provers = [
+        Prover(params, Witness(Ristretto255.random_scalar(rng))) for _ in range(5)
+    ]
+    proofs = [p.prove_with_transcript(rng, Transcript()) for p in provers]
+    backend = backend_mod.TpuBackend(mesh_devices=0)
+    assert backend._sharded_msm is not None
+
+    bv = BatchVerifier(backend=backend)
+    for p, pf in zip(provers, proofs):
+        bv.add(params, p.statement, pf)
+    bv.add(params, provers[0].statement, proofs[1])
+    res = bv.verify(rng)
+    assert [r is None for r in res] == [True] * 5 + [False]
+
+    importlib.reload(backend_mod)
