@@ -214,6 +214,18 @@ def scalarmul(point: bytes, scalar: bytes) -> bytes | None:
     return out.raw
 
 
+def basemul_init(g: bytes, h: bytes) -> bool:
+    """Explicitly (re)build the comb tables for a generator pair; used to
+    retry once after a churn-race ``double_basemul`` failure.  False when
+    the library is absent or a generator fails to decode."""
+    lib = _ristretto_lib()
+    if lib is None or not hasattr(lib, "cpzk_double_basemul"):
+        return False
+    if len(g) != 32 or len(h) != 32:
+        raise ValueError("g and h must be 32 bytes")
+    return bool(lib.cpzk_basemul_init(g, h))
+
+
 def double_basemul(g: bytes, h: bytes, scalar: bytes) -> tuple[bytes, bytes] | None:
     """Constant-time (s*G, s*H) via the native fixed-base comb; None when
     the library (or the symbol) is unavailable, a generator fails to

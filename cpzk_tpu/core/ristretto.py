@@ -23,6 +23,10 @@ WIDE_REDUCTION_BYTES = 64
 # Domain separation tag for the second generator h (ristretto.rs:27).
 GENERATOR_H_DST = b"chaum-pedersen-zkp-v1.0.0-generator-h"
 
+# one-shot flag: warn the first time a SECRET scalar multiplication has to
+# fall back to the variable-time Python ladder (native core unavailable)
+_WARNED_VARTIME_FALLBACK = False
+
 
 class Scalar:
     """Scalar mod ℓ. Equality is constant-time on the canonical encoding."""
@@ -197,11 +201,26 @@ class Ristretto255:
         docs/security.md."""
         if scalar.value == 0:
             return Ristretto255.identity(), Ristretto255.identity()
-        out = _native.double_basemul(
-            g.wire(), h.wire(), scalars.sc_to_bytes(scalar.value)
-        )
+        sc = scalars.sc_to_bytes(scalar.value)
+        out = _native.double_basemul(g.wire(), h.wire(), sc)
+        if out is None and _native.basemul_init(g.wire(), h.wire()):
+            # None can also mean the rare comb-table churn race (another
+            # thread swapped the generator pair between build and read);
+            # one explicit rebuild + retry resolves it without giving up
+            # the constant-time path
+            out = _native.double_basemul(g.wire(), h.wire(), sc)
         if out is not None:
             return Element(wire=out[0]), Element(wire=out[1])
+        global _WARNED_VARTIME_FALLBACK
+        if not _WARNED_VARTIME_FALLBACK:
+            _WARNED_VARTIME_FALLBACK = True
+            import logging
+
+            logging.getLogger("cpzk_tpu").warning(
+                "native constant-time fixed-base comb unavailable; secret-"
+                "scalar multiplications are using the variable-time Python "
+                "ladder (see docs/security.md)"
+            )
         return (
             Element(edwards.pt_scalar_mul(g.point, scalar.value)),
             Element(edwards.pt_scalar_mul(h.point, scalar.value)),

@@ -138,8 +138,8 @@ def reduce_wide(x: jnp.ndarray) -> jnp.ndarray:
     [20, ...] limbs of x mod l.
 
     q_hat = floor( floor(x / b^(K-1)) * mu / b^(K+1) );  r = x - q_hat*l.
-    The classic bound gives r < 3l, so two conditional subtractions
-    finish; we spend a third for slack on the truncated-product path.
+    The classic bound gives r < 3l, so the two conditional subtractions
+    below finish the reduction.
     """
     batch = x.shape[1:]
     pad_cfg = [(0, 0)] * len(batch)

@@ -26,16 +26,12 @@ from . import batching, metrics
 from .config import RateLimiter, RateLimitExceeded
 from .proto import SERVICE_NAME, load_pb2, method_types
 from .state import ServerState, UserData
+from .state import user_id_error as _user_id_error
 
-MAX_USER_ID_LEN = 256
 MAX_ELEMENT_WIRE = 4096
 MAX_CHALLENGE_ID = 64
 MAX_PROOF_WIRE = 8192
 MAX_BATCH = 1000
-
-
-def _valid_user_id_chars(user_id: str) -> bool:
-    return all(c.isalnum() or c in "_-." for c in user_id)
 
 
 class AuthServiceImpl:
@@ -443,16 +439,6 @@ class AuthServiceImpl:
         metrics.histogram("auth.verify_batch.duration").observe(time.perf_counter() - start)
         metrics.counter("auth.verify_batch.success").inc()
         return self.pb2.BatchVerificationResponse(results=results)
-
-
-def _user_id_error(user_id: str) -> str | None:
-    if not user_id:
-        return "User ID cannot be empty"
-    if len(user_id) > MAX_USER_ID_LEN:
-        return "User ID too long"
-    if not _valid_user_id_chars(user_id):
-        return "User ID contains invalid characters"
-    return None
 
 
 def _proof_args_error(challenge_id: bytes, proof: bytes, index: int | None = None) -> str | None:

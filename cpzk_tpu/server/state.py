@@ -31,6 +31,25 @@ MAX_TOTAL_USERS = 10_000
 MAX_TOTAL_CHALLENGES = 50_000
 MAX_TOTAL_SESSIONS = 100_000
 
+MAX_USER_ID_LEN = 256
+
+
+def _valid_user_id_chars(user_id: str) -> bool:
+    return all(c.isalnum() or c in "_-." for c in user_id)
+
+
+def user_id_error(user_id: str) -> str | None:
+    """Registration-time user-id rules (service.rs:37-56 twin): non-empty,
+    <=256 chars, ``[A-Za-z0-9._-]`` only.  Shared by the gRPC service and
+    the snapshot-restore trust boundary so the two can never drift."""
+    if not user_id:
+        return "User ID cannot be empty"
+    if len(user_id) > MAX_USER_ID_LEN:
+        return "User ID too long"
+    if not _valid_user_id_chars(user_id):
+        return "User ID contains invalid characters"
+    return None
+
 
 def _now() -> int:
     return int(time.time())
@@ -82,6 +101,10 @@ class ServerState:
 
     def __init__(self) -> None:
         self._lock = asyncio.Lock()
+        # serializes whole snapshot() calls: overlapping writers (cleanup
+        # sweep vs shutdown) must rename in document-build order, or an
+        # older doc can land over a newer one with _persist_dirty false
+        self._snapshot_lock = asyncio.Lock()
         self._users: dict[str, UserData] = {}
         self._challenges: dict[bytes, ChallengeData] = {}
         self._user_challenges: dict[str, list[bytes]] = {}
@@ -236,7 +259,10 @@ class ServerState:
         snapshot).  The in-memory copy is taken under the state lock; the
         serialization + fsync + atomic rename run on a worker thread so
         the event loop (and every handler waiting on the lock) never
-        stalls on disk I/O."""
+        stalls on disk I/O.  Whole calls serialize on a snapshot lock so
+        overlapping writers (cleanup sweep vs shutdown) rename in
+        document-build order — otherwise an older document could land
+        over a newer one with ``_persist_dirty`` already false."""
         import asyncio as _asyncio
         import json
         import os
@@ -244,47 +270,69 @@ class ServerState:
         from ..core.ristretto import Ristretto255
 
         eb = Ristretto255.element_to_bytes
-        async with self._lock:
-            if not self._persist_dirty:
-                return False
-            doc = {
-                "version": self.SNAPSHOT_VERSION,
-                "users": {
-                    uid: {
-                        "y1": eb(u.statement.y1).hex(),
-                        "y2": eb(u.statement.y2).hex(),
-                        "registered_at": u.registered_at,
-                    }
-                    for uid, u in self._users.items()
-                },
-                "sessions": [
-                    {
-                        "token": s.token,
-                        "user_id": s.user_id,
-                        "created_at": s.created_at,
-                        "expires_at": s.expires_at,
-                    }
-                    for s in self._sessions.values()
-                    if not s.is_expired()
-                ],
-            }
-            self._persist_dirty = False
+        async with self._snapshot_lock:
+            async with self._lock:
+                if not self._persist_dirty:
+                    return False
+                doc = {
+                    "version": self.SNAPSHOT_VERSION,
+                    "users": {
+                        uid: {
+                            "y1": eb(u.statement.y1).hex(),
+                            "y2": eb(u.statement.y2).hex(),
+                            "registered_at": u.registered_at,
+                        }
+                        for uid, u in self._users.items()
+                    },
+                    "sessions": [
+                        {
+                            "token": s.token,
+                            "user_id": s.user_id,
+                            "created_at": s.created_at,
+                            "expires_at": s.expires_at,
+                        }
+                        for s in self._sessions.values()
+                        if not s.is_expired()
+                    ],
+                }
+                self._persist_dirty = False
 
-        def write() -> None:
-            tmp = f"{path}.tmp"
-            fd = os.open(tmp, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
-            with os.fdopen(fd, "w") as f:
-                json.dump(doc, f)
-                f.flush()
-                os.fsync(f.fileno())  # data durable before the rename
-            os.replace(tmp, path)
+            def write() -> None:
+                # unique tmp name so a racing writer can never rename a
+                # torn document; a distinctive prefix lets us sweep debris
+                # a hard crash (SIGKILL between mkstemp and rename) left
+                # behind — those files hold live bearer tokens
+                import tempfile
 
-        try:
-            await _asyncio.to_thread(write)
-        except BaseException:
-            self._persist_dirty = True  # retry next sweep
-            raise
-        return True
+                d = os.path.dirname(os.path.abspath(path)) or "."
+                prefix = "." + os.path.basename(path) + ".tmp."
+                for stale in os.listdir(d):
+                    if stale.startswith(prefix):
+                        try:
+                            os.unlink(os.path.join(d, stale))
+                        except OSError:
+                            pass
+                # mkstemp creates 0600 — the bearer-token protection requirement
+                fd, tmp = tempfile.mkstemp(prefix=prefix, dir=d)
+                try:
+                    with os.fdopen(fd, "w") as f:
+                        json.dump(doc, f)
+                        f.flush()
+                        os.fsync(f.fileno())  # data durable before the rename
+                    os.replace(tmp, path)
+                except BaseException:
+                    try:
+                        os.unlink(tmp)
+                    except OSError:
+                        pass
+                    raise
+
+            try:
+                await _asyncio.to_thread(write)
+            except BaseException:
+                self._persist_dirty = True  # retry next sweep
+                raise
+            return True
 
     async def restore(self, path: str) -> tuple[int, int]:
         """Load a snapshot into an empty state; returns (users, sessions).
@@ -303,43 +351,65 @@ class ServerState:
             raise InvalidParams(
                 f"Unsupported state snapshot version: {doc.get('version')!r}"
             )
+        # Validate and build into locals first, commit only after the FULL
+        # document passes: a mid-document rejection must not leave a
+        # partially-populated state (a caller catching the error and
+        # serving anyway would be running half the tampered snapshot).
+        if len(doc["users"]) > MAX_TOTAL_USERS:
+            raise InvalidParams("Snapshot exceeds the user capacity cap")
+        if len(doc["sessions"]) > MAX_TOTAL_SESSIONS:
+            raise InvalidParams("Snapshot exceeds the session capacity cap")
+        users: dict[str, UserData] = {}
+        for uid, u in doc["users"].items():
+            # same rules a live registration passes (service.rs:37-56,
+            # :93-97): a tampered snapshot must not smuggle in what the
+            # RPC would reject
+            msg = user_id_error(uid)
+            if msg is not None:
+                raise InvalidParams(f"Snapshot user {uid!r}: {msg}")
+            st = Statement(
+                Ristretto255.element_from_bytes(bytes.fromhex(u["y1"])),
+                Ristretto255.element_from_bytes(bytes.fromhex(u["y2"])),
+            )
+            if Ristretto255.is_identity(st.y1) or Ristretto255.is_identity(st.y2):
+                raise InvalidParams(
+                    f"Snapshot user {uid!r} has an identity statement element"
+                )
+            users[uid] = UserData(
+                user_id=uid, statement=st, registered_at=int(u["registered_at"])
+            )
+        sessions: dict[str, SessionData] = {}
+        user_sessions: dict[str, list[str]] = {}
+        seen_tokens: set[str] = set()
+        for s in doc["sessions"]:
+            created, expires = int(s["created_at"]), int(s["expires_at"])
+            if expires <= created or expires - created > SESSION_EXPIRY_SECONDS:
+                raise InvalidParams("Snapshot session has an invalid expiry")
+            data = SessionData(
+                token=str(s["token"]),
+                user_id=str(s["user_id"]),
+                created_at=created,
+                expires_at=expires,
+            )
+            if data.user_id not in users:
+                raise InvalidParams(
+                    "Snapshot session references an unregistered user"
+                )
+            if data.token in seen_tokens:
+                raise InvalidParams("Snapshot contains a duplicate session token")
+            seen_tokens.add(data.token)
+            if data.is_expired():
+                continue
+            per_user = user_sessions.setdefault(data.user_id, [])
+            if len(per_user) >= MAX_SESSIONS_PER_USER:
+                raise InvalidParams("Snapshot exceeds a per-user session cap")
+            sessions[data.token] = data
+            per_user.append(data.token)
         async with self._lock:
             if self._users or self._sessions:
                 raise InvalidParams("restore requires an empty state")
-            if len(doc["users"]) > MAX_TOTAL_USERS:
-                raise InvalidParams("Snapshot exceeds the user capacity cap")
-            if len(doc["sessions"]) > MAX_TOTAL_SESSIONS:
-                raise InvalidParams("Snapshot exceeds the session capacity cap")
-            for uid, u in doc["users"].items():
-                st = Statement(
-                    Ristretto255.element_from_bytes(bytes.fromhex(u["y1"])),
-                    Ristretto255.element_from_bytes(bytes.fromhex(u["y2"])),
-                )
-                self._users[uid] = UserData(
-                    user_id=uid, statement=st, registered_at=int(u["registered_at"])
-                )
-            n_sessions = 0
-            for s in doc["sessions"]:
-                created, expires = int(s["created_at"]), int(s["expires_at"])
-                if expires <= created or expires - created > SESSION_EXPIRY_SECONDS:
-                    raise InvalidParams("Snapshot session has an invalid expiry")
-                data = SessionData(
-                    token=str(s["token"]),
-                    user_id=str(s["user_id"]),
-                    created_at=created,
-                    expires_at=expires,
-                )
-                if data.user_id not in self._users:
-                    raise InvalidParams(
-                        "Snapshot session references an unregistered user"
-                    )
-                if data.is_expired():
-                    continue
-                per_user = self._user_sessions.setdefault(data.user_id, [])
-                if len(per_user) >= MAX_SESSIONS_PER_USER:
-                    raise InvalidParams("Snapshot exceeds a per-user session cap")
-                self._sessions[data.token] = data
-                per_user.append(data.token)
-                n_sessions += 1
+            self._users = users
+            self._sessions = sessions
+            self._user_sessions = user_sessions
             self._persist_dirty = True  # freshly-restored state is unsaved
-            return len(self._users), n_sessions
+            return len(users), len(sessions)

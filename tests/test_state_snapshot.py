@@ -98,6 +98,79 @@ def test_restore_rejects_bad_input(tmp_path):
     run(main())
 
 
+def test_restore_applies_registration_invariants(tmp_path):
+    """A tampered snapshot cannot smuggle in what the register RPC rejects
+    (service.rs:37-56,:93-97): identity statement elements, invalid user
+    ids, duplicate session tokens."""
+    path = str(tmp_path / "state.json")
+    rng, params = SecureRng(), Parameters.new()
+    eb = Ristretto255.element_to_bytes
+    stmt = make_statement(rng, params)
+    good_user = {"y1": eb(stmt.y1).hex(), "y2": eb(stmt.y2).hex(),
+                 "registered_at": 1}
+
+    def write(doc):
+        with open(path, "w") as f:
+            json.dump(doc, f)
+
+    async def main():
+        # every rejection runs against ONE instance: a failed restore must
+        # be all-or-nothing, leaving the state empty and retryable
+        st = ServerState()
+
+        # identity y1 (32 zero bytes decodes canonically but must reject)
+        write({"version": 1, "sessions": [],
+               "users": {"u": {"y1": "00" * 32, "y2": good_user["y2"],
+                               "registered_at": 1}}})
+        with pytest.raises(Error, match="identity"):
+            await st.restore(path)
+
+        # user-id rules: empty, overlong, bad charset
+        for uid in ["", "x" * 257, "bad user!"]:
+            write({"version": 1,
+                   "users": {"ok-user": dict(good_user), uid: dict(good_user)},
+                   "sessions": []})
+            with pytest.raises(Error, match="User ID"):
+                await st.restore(path)
+
+        # duplicate session tokens must not silently overwrite
+        sess = {"token": "tok", "user_id": "u", "created_at": 10**10,
+                "expires_at": 10**10 + 60}
+        write({"version": 1, "users": {"u": dict(good_user)},
+               "sessions": [dict(sess), dict(sess)]})
+        with pytest.raises(Error, match="duplicate session"):
+            await st.restore(path)
+        assert await st.user_count() == 0  # nothing leaked from rejected docs
+
+        # control: the untampered document restores fine on the same object
+        write({"version": 1, "users": {"u": dict(good_user)},
+               "sessions": [dict(sess)]})
+        nu, ns = await st.restore(path)
+        assert (nu, ns) == (1, 1)
+
+    run(main())
+
+
+def test_concurrent_snapshots_leave_no_debris(tmp_path):
+    """Overlapping snapshot writers (cleanup sweep vs shutdown) use unique
+    tmp names: the survivor is valid JSON and no tmp files leak."""
+    path = str(tmp_path / "state.json")
+
+    async def main():
+        st = ServerState()
+        rng, params = SecureRng(), Parameters.new()
+        await st.register_user(UserData("u0", make_statement(rng, params), 1))
+        writes = []
+        for i in range(4):
+            await st.create_session(f"tok-{i}", "u0")  # re-dirty between writes
+            writes.append(st.snapshot(path))
+        assert any(await asyncio.gather(*writes))
+
+    run(main())
+    assert json.load(open(path))["version"] == 1
+    assert os.listdir(tmp_path.as_posix()) == ["state.json"]
+
+
 def test_restore_drops_expired_sessions(tmp_path):
     path = str(tmp_path / "state.json")
 
