@@ -64,6 +64,21 @@ def _declare(lib: ctypes.CDLL) -> None:
         ]
         lib.cpzk_point_roundtrip.restype = ctypes.c_int
         lib.cpzk_point_roundtrip.argtypes = [ctypes.c_char_p, ctypes.c_char_p]
+    if hasattr(lib, "cpzk_point_validate"):
+        lib.cpzk_point_validate.restype = ctypes.c_int
+        lib.cpzk_point_validate.argtypes = [ctypes.c_char_p]
+    if hasattr(lib, "cpzk_batch_decode"):
+        lib.cpzk_batch_decode.restype = ctypes.c_int
+        lib.cpzk_batch_decode.argtypes = [
+            ctypes.c_size_t, ctypes.c_char_p, ctypes.c_char_p,
+            ctypes.c_char_p, ctypes.c_int,
+        ]
+    if hasattr(lib, "cpzk_sc_mul_beta"):
+        lib.cpzk_sc_mul_beta.restype = ctypes.c_int
+        lib.cpzk_sc_mul_beta.argtypes = [
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p,
+        ]
+    if hasattr(lib, "cpzk_scalarmul"):
         lib.cpzk_scalarmul.restype = ctypes.c_int
         lib.cpzk_scalarmul.argtypes = [
             ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p,
@@ -97,13 +112,14 @@ def load() -> ctypes.CDLL | None:
 
     # Force-rebuild once if the .so predates the newest symbols, but never
     # discard a working (older) library — a failed rebuild keeps the old
-    # file and the old capabilities.
-    if not hasattr(lib, "cpzk_double_basemul") and _build(force=True):
+    # file and the old capabilities.  Keyed to the NEWEST export so every
+    # symbol generation triggers exactly one refresh.
+    if not hasattr(lib, "cpzk_batch_decode") and _build(force=True):
         try:
             relib = ctypes.CDLL(_LIB_PATH)
         except OSError:
             relib = None
-        if relib is not None and hasattr(relib, "cpzk_double_basemul"):
+        if relib is not None and hasattr(relib, "cpzk_batch_decode"):
             lib = relib
 
     _declare(lib)
@@ -188,6 +204,53 @@ def verify_rows(
     out = ctypes.create_string_buffer(n)
     lib.cpzk_verify_rows(n, g, h, y1s, y2s, r1s, r2s, ss, cs, out, threads)
     return [b == 1 for b in out.raw]
+
+
+def batch_decode(wires: bytes, threads: int = 0) -> tuple[bytes, bytes] | None:
+    """Decode n concatenated 32-byte wires to extended coordinates on the
+    native worker pool; returns (coords, ok) with coords n*128 bytes
+    (X|Y|Z|T, canonical LE field bytes each) and ok n flag bytes.  None
+    when the library is unavailable.  The device data plane uses this to
+    marshal points without per-point Python big-int decodes."""
+    lib = _ristretto_lib()
+    if lib is None or not hasattr(lib, "cpzk_batch_decode"):
+        return None
+    if len(wires) % 32:
+        raise ValueError("wires must be a multiple of 32 bytes")
+    n = len(wires) // 32
+    coords = ctypes.create_string_buffer(128 * n)
+    ok = ctypes.create_string_buffer(n)
+    if threads <= 0:
+        threads = min(os.cpu_count() or 1, max(1, n // 256 + 1))
+    lib.cpzk_batch_decode(n, wires, coords, ok, threads)
+    return coords.raw, ok.raw
+
+
+def point_validate(wire: bytes) -> bool | None:
+    """Canonical-validity check via the native decoder (no re-encode, so
+    no field inversion — the cheap ingress-path variant); None when the
+    library is unavailable."""
+    lib = _ristretto_lib()
+    if lib is None or not hasattr(lib, "cpzk_point_validate"):
+        return None
+    if len(wire) != 32:
+        return False
+    return bool(lib.cpzk_point_validate(wire))
+
+
+def sc_mul_beta(beta16: bytes, scalar: bytes) -> bytes | None:
+    """(beta * scalar) mod l with a 16-byte little-endian beta, via the
+    native vartime scalar unit; None when the library is unavailable.
+    Exposed for differential testing of the merged-verify weight math."""
+    lib = _ristretto_lib()
+    if lib is None or not hasattr(lib, "cpzk_sc_mul_beta"):
+        return None
+    if len(beta16) != 16 or len(scalar) != 32:
+        raise ValueError("beta must be 16 bytes and scalar 32 bytes")
+    out = ctypes.create_string_buffer(32)
+    if not lib.cpzk_sc_mul_beta(beta16, scalar, out):
+        raise ValueError("scalar out of domain (must be < 2^253)")
+    return out.raw
 
 
 def point_roundtrip(wire: bytes) -> bytes | None:

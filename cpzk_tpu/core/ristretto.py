@@ -76,10 +76,13 @@ class Element:
             raise ValueError("Element needs a point or wire bytes")
         self._point = point
         self._wire = wire
-        # True when this element's wire bytes have already passed canonical
-        # decode (element_from_bytes) — recompression validation is then a
-        # no-op re-check and is skipped (the reference's validate exists to
-        # catch non-canonical encodings, which the parse already rejects)
+        # True when the wire bytes are known canonical: they passed the
+        # canonical decode (element_from_bytes) or came out of an internal
+        # group op whose encode is canonical by construction — then
+        # recompression validation is a no-op re-check and is skipped.
+        # The default stays False so wire bytes handed to this public
+        # constructor WITHOUT a canonical decode still get validated
+        # (fail-closed); internal construction sites opt in explicitly.
         self._validated = validated
 
     @property
@@ -119,7 +122,7 @@ class Ristretto255:
     @classmethod
     def generator_g(cls) -> Element:
         if cls._GENERATOR_G_CACHE is None:
-            cls._GENERATOR_G_CACHE = Element(edwards.BASEPOINT)
+            cls._GENERATOR_G_CACHE = Element(edwards.BASEPOINT, validated=True)
         return cls._GENERATOR_G_CACHE
 
     @classmethod
@@ -127,7 +130,7 @@ class Ristretto255:
         """Second generator: SHA-512(DST) → one-way map (ristretto.rs:86-91)."""
         if cls._GENERATOR_H_CACHE is None:
             digest = hashlib.sha512(GENERATOR_H_DST).digest()
-            cls._GENERATOR_H_CACHE = Element(edwards.ristretto_from_uniform_bytes(digest))
+            cls._GENERATOR_H_CACHE = Element(edwards.ristretto_from_uniform_bytes(digest), validated=True)
         return cls._GENERATOR_H_CACHE
 
     @staticmethod
@@ -148,17 +151,15 @@ class Ristretto255:
         if len(data) != RISTRETTO_BYTES:
             raise InvalidGroupElement(f"Expected {RISTRETTO_BYTES} bytes, got {len(data)}")
         # Native fast path: ge_decode applies the same canonical rules as
-        # the Python decoder (tests/test_native.py differential), and a
-        # successful decode re-encodes to the identical bytes, so validity
-        # is exactly "roundtrip returns non-empty".  Coordinates are then
+        # the Python decoder (tests/test_native.py differential), and the
+        # RFC 9496 decode rejects every non-canonical encoding, so decode
+        # success alone is validity — no re-encode (and no field
+        # inversion) on the hot ingress path.  Coordinates are then
         # materialized lazily — most wire elements (proof parsing, server
         # ingress) never need them.
-        rt = _native.point_roundtrip(bytes(data))
-        if rt is not None:
-            # canonical decode implies rt == data; the equality check is
-            # free defense-in-depth against a decoder accepting a
-            # non-canonical encoding (would re-encode differently)
-            if rt != bytes(data):
+        ok = _native.point_validate(bytes(data))
+        if ok is not None:
+            if not ok:
                 raise InvalidGroupElement("Bytes do not represent a valid Ristretto point")
             return Element(wire=bytes(data), validated=True)
         point = edwards.ristretto_decode(data)
@@ -186,8 +187,8 @@ class Ristretto255:
             return Ristretto255.identity()
         out = _native.scalarmul(element.wire(), scalars.sc_to_bytes(scalar.value))
         if out:  # None = no library; b"" = decode failure (fall through)
-            return Element(wire=out)
-        return Element(edwards.pt_scalar_mul(element.point, scalar.value))
+            return Element(wire=out, validated=True)
+        return Element(edwards.pt_scalar_mul(element.point, scalar.value), validated=True)
 
     @staticmethod
     def double_base_mul(g: Element, h: Element, scalar: Scalar) -> tuple[Element, Element]:
@@ -210,7 +211,7 @@ class Ristretto255:
             # the constant-time path
             out = _native.double_basemul(g.wire(), h.wire(), sc)
         if out is not None:
-            return Element(wire=out[0]), Element(wire=out[1])
+            return Element(wire=out[0], validated=True), Element(wire=out[1], validated=True)
         global _WARNED_VARTIME_FALLBACK
         if not _WARNED_VARTIME_FALLBACK:
             _WARNED_VARTIME_FALLBACK = True
@@ -222,8 +223,8 @@ class Ristretto255:
                 "ladder (see docs/security.md)"
             )
         return (
-            Element(edwards.pt_scalar_mul(g.point, scalar.value)),
-            Element(edwards.pt_scalar_mul(h.point, scalar.value)),
+            Element(edwards.pt_scalar_mul(g.point, scalar.value), validated=True),
+            Element(edwards.pt_scalar_mul(h.point, scalar.value), validated=True),
         )
 
     @staticmethod
@@ -232,12 +233,12 @@ class Ristretto255:
         curve implementation is additive) — ristretto.rs:158-160."""
         out = _native.point_add(a.wire(), b.wire())
         if out:
-            return Element(wire=out)
-        return Element(edwards.pt_add(a.point, b.point))
+            return Element(wire=out, validated=True)
+        return Element(edwards.pt_add(a.point, b.point), validated=True)
 
     @staticmethod
     def identity() -> Element:
-        return Element(edwards.IDENTITY, bytes(RISTRETTO_BYTES))
+        return Element(edwards.IDENTITY, bytes(RISTRETTO_BYTES), validated=True)
 
     @staticmethod
     def is_identity(element: Element) -> bool:

@@ -19,9 +19,15 @@
 // secret-dependent branches or memory addressing.
 
 #include <cstdint>
+#include <cstdio>
 #include <cstring>
 #include <cstdlib>
+#include <fcntl.h>
 #include <pthread.h>
+#include <unistd.h>
+#if defined(__linux__)
+#include <sys/random.h>
+#endif
 
 extern "C" {
 
@@ -76,21 +82,18 @@ static void fe_carry(fe &h) {
 }
 
 static void fe_mul(fe &h, const fe &f, const fe &g) {
-    u128 t[5] = {0, 0, 0, 0, 0};
-    for (int i = 0; i < 5; i++) {
-        for (int j = 0; j < 5; j++) {
-            int k = i + j;
-            u128 prod = (u128)f.v[i] * g.v[j];
-            if (k >= 5) {
-                k -= 5;
-                prod *= 19;
-            }
-            t[k] += prod;
-        }
-    }
-    uint64_t c;
-    uint64_t r[5];
-    c = 0;
+    // donna-style: fold the 19x wrap into pre-scaled u64 factors (g[j] <
+    // 2^52, so 19*g[j] < 2^57 stays a single 64x64 product per term)
+    const uint64_t f0 = f.v[0], f1 = f.v[1], f2 = f.v[2], f3 = f.v[3], f4 = f.v[4];
+    const uint64_t g0 = g.v[0], g1 = g.v[1], g2 = g.v[2], g3 = g.v[3], g4 = g.v[4];
+    const uint64_t g1_19 = 19 * g1, g2_19 = 19 * g2, g3_19 = 19 * g3, g4_19 = 19 * g4;
+    u128 t0 = (u128)f0 * g0 + (u128)f1 * g4_19 + (u128)f2 * g3_19 + (u128)f3 * g2_19 + (u128)f4 * g1_19;
+    u128 t1 = (u128)f0 * g1 + (u128)f1 * g0 + (u128)f2 * g4_19 + (u128)f3 * g3_19 + (u128)f4 * g2_19;
+    u128 t2 = (u128)f0 * g2 + (u128)f1 * g1 + (u128)f2 * g0 + (u128)f3 * g4_19 + (u128)f4 * g3_19;
+    u128 t3 = (u128)f0 * g3 + (u128)f1 * g2 + (u128)f2 * g1 + (u128)f3 * g0 + (u128)f4 * g4_19;
+    u128 t4 = (u128)f0 * g4 + (u128)f1 * g3 + (u128)f2 * g2 + (u128)f3 * g1 + (u128)f4 * g0;
+    u128 t[5] = {t0, t1, t2, t3, t4};
+    uint64_t c = 0, r[5];
     for (int i = 0; i < 5; i++) {
         u128 acc = t[i] + c;
         r[i] = (uint64_t)acc & MASK51;
@@ -222,6 +225,42 @@ static void fe_pow2523(fe &h, const fe &f) {
     fe_mul(h, t0, f);                                  // 2^252-3
 }
 
+// h = f^(p-2) = 1/f (standard ed25519 inversion chain); only used for
+// one-time table normalization, never on a hot path
+static void fe_invert(fe &h, const fe &f) {
+    fe t0, t1, t2, t3;
+    fe_sq(t0, f);                                      // 2
+    fe_sq(t1, t0); fe_sq(t1, t1);                      // 8
+    fe_mul(t1, f, t1);                                 // 9
+    fe_mul(t0, t0, t1);                                // 11
+    fe_sq(t2, t0);                                     // 22
+    fe_mul(t1, t1, t2);                                // 31 = 2^5-1
+    fe_sq(t2, t1);
+    for (int i = 1; i < 5; i++) fe_sq(t2, t2);
+    fe_mul(t1, t2, t1);                                // 2^10-1
+    fe_sq(t2, t1);
+    for (int i = 1; i < 10; i++) fe_sq(t2, t2);
+    fe_mul(t2, t2, t1);                                // 2^20-1
+    fe_sq(t3, t2);
+    for (int i = 1; i < 20; i++) fe_sq(t3, t3);
+    fe_mul(t2, t3, t2);                                // 2^40-1
+    fe_sq(t2, t2);
+    for (int i = 1; i < 10; i++) fe_sq(t2, t2);
+    fe_mul(t1, t2, t1);                                // 2^50-1
+    fe_sq(t2, t1);
+    for (int i = 1; i < 50; i++) fe_sq(t2, t2);
+    fe_mul(t2, t2, t1);                                // 2^100-1
+    fe_sq(t3, t2);
+    for (int i = 1; i < 100; i++) fe_sq(t3, t3);
+    fe_mul(t2, t3, t2);                                // 2^200-1
+    fe_sq(t2, t2);
+    for (int i = 1; i < 50; i++) fe_sq(t2, t2);
+    fe_mul(t1, t2, t1);                                // 2^250-1
+    fe_sq(t1, t1);
+    for (int i = 1; i < 5; i++) fe_sq(t1, t1);         // 2^255-32
+    fe_mul(h, t1, t0);                                 // 2^255-21 = p-2
+}
+
 // (was_square, r) = SQRT_RATIO_M1(u, v)  (RFC 9496 §3.1)
 static int fe_sqrt_ratio_m1(fe &r, const fe &u, const fe &v) {
     fe v3, v7, t, check, neg_u, neg_u_i;
@@ -310,6 +349,39 @@ static void ge_neg(ge &r, const ge &p) {
 
 static int ge_is_identity(const ge &p) {
     return fe_iszero(p.X) || fe_iszero(p.Y);
+}
+
+// affine precomputed form (y+x, y-x, 2d*x*y) for table entries: the
+// mixed add below is 7 muls vs ge_add's 9, and entries shrink 160->120B
+struct gep {
+    fe ypx, ymx, t2d;
+};
+
+// r = p + q with q affine-precomputed (madd-2008-hwcd, unified: a zero
+// t2d/unit ypx+ymx entry is the identity and adds as a no-op)
+static void ge_madd(ge &r, const ge &p, const gep &q) {
+    fe a, b, c, d, e, f, g, h, t;
+    fe_sub(t, p.Y, p.X); fe_carry(t);
+    fe_mul(a, t, q.ymx);
+    fe_add(t, p.Y, p.X);
+    fe_mul(b, t, q.ypx);
+    fe_mul(c, p.T, q.t2d);
+    fe_add(d, p.Z, p.Z);
+    fe_carry(d);
+    fe_sub(e, b, a); fe_carry(e);
+    fe_sub(f, d, c); fe_carry(f);
+    fe_add(g, d, c); fe_carry(g);
+    fe_add(h, b, a); fe_carry(h);
+    fe_mul(r.X, e, f);
+    fe_mul(r.Y, g, h);
+    fe_mul(r.Z, f, g);
+    fe_mul(r.T, e, h);
+}
+
+static void gep_neg(gep &r, const gep &q) {
+    r.ypx = q.ymx;
+    r.ymx = q.ypx;
+    fe_neg(r.t2d, q.t2d);
 }
 
 // RFC 9496 §4.3.1 DECODE; returns 0 on invalid encodings
@@ -554,8 +626,282 @@ static void ge_scalarmul(ge &r, const ge &p, const uint8_t *scalar) {
 }
 
 // ---------------------------------------------------------------------------
-// Chaum-Pedersen row verification + threaded batch entry point
+// scalar arithmetic mod l = 2^252 + q (vartime; verification inputs are
+// public).  Needed for the beta-merged verification equation below.
 // ---------------------------------------------------------------------------
+
+struct sc4 { uint64_t v[4]; };  // 256-bit little-endian
+
+// q = l - 2^252 = 27742317777372353535851937790883648493 (125 bits)
+static const uint64_t SC_Q[2] = {0x5812631A5CF5D3EDULL, 0x14DEF9DEA2F79CD6ULL};
+// l itself: bit 252 set in word 3
+static const uint64_t SC_L[4] = {0x5812631A5CF5D3EDULL, 0x14DEF9DEA2F79CD6ULL,
+                                 0, 0x1000000000000000ULL};
+
+static uint64_t load64le(const uint8_t *b) {
+    uint64_t r = 0;
+    for (int i = 7; i >= 0; i--) r = (r << 8) | b[i];
+    return r;
+}
+
+static void store64le(uint8_t *b, uint64_t v) {
+    for (int i = 0; i < 8; i++) { b[i] = (uint8_t)v; v >>= 8; }
+}
+
+// r >= l ?
+static int sc_geq_l(const uint64_t r[4]) {
+    for (int i = 3; i >= 0; i--) {
+        if (r[i] > SC_L[i]) return 1;
+        if (r[i] < SC_L[i]) return 0;
+    }
+    return 1;
+}
+
+static void sc_sub_l(uint64_t r[4]) {
+    uint64_t borrow = 0;
+    for (int i = 0; i < 4; i++) {
+        uint64_t d = r[i] - SC_L[i] - borrow;
+        borrow = (r[i] < SC_L[i] + borrow) || (SC_L[i] + borrow < SC_L[i]);
+        r[i] = d;
+    }
+}
+
+static void sc_add_l(uint64_t r[4]) {
+    u128 c = 0;
+    for (int i = 0; i < 4; i++) {
+        c += (u128)r[i] + SC_L[i];
+        r[i] = (uint64_t)c;
+        c >>= 64;
+    }
+}
+
+// out[na+nb] = a * b, row-wise schoolbook (no intermediate overflow:
+// each step is product + word + carry < 2^128).  out must be zeroed to
+// na+nb words by the caller's sizing; we do it here.
+static void mul_words(uint64_t *out, const uint64_t *a, int na,
+                      const uint64_t *b, int nb) {
+    memset(out, 0, (size_t)(na + nb) * 8);
+    for (int i = 0; i < na; i++) {
+        uint64_t carry = 0;
+        for (int j = 0; j < nb; j++) {
+            u128 cur = (u128)a[i] * b[j] + out[i + j] + carry;
+            out[i + j] = (uint64_t)cur;
+            carry = (uint64_t)(cur >> 64);
+        }
+        out[i + nb] = carry;  // untouched so far in row-wise order
+    }
+}
+
+// r = p mod l for p < 2^381 (6 words), via 2^252 === -q (mod l) twice
+static void sc_reduce384(uint64_t r[4], const uint64_t p[6]) {
+    const uint64_t MASK60 = 0x0FFFFFFFFFFFFFFFULL;
+    // split: lo = p mod 2^252 (4 words), hi = p >> 252 (< 2^129, 3 words)
+    uint64_t lo[4] = {p[0], p[1], p[2], p[3] & MASK60};
+    uint64_t hi[3];
+    hi[0] = (p[3] >> 60) | (p[4] << 4);
+    hi[1] = (p[4] >> 60) | (p[5] << 4);
+    hi[2] = p[5] >> 60;
+    // t = hi * q  (< 2^254, 4 words after the drop of the zero top word)
+    uint64_t t5[5];
+    mul_words(t5, hi, 3, SC_Q, 2);
+    uint64_t t[4] = {t5[0], t5[1], t5[2], t5[3]};
+    // t = t_hi * 2^252 + t_lo with t_hi < 4;  p === lo - t_lo + t_hi*q
+    uint64_t thi = t[3] >> 60;
+    uint64_t tlo[4] = {t[0], t[1], t[2], t[3] & MASK60};
+    // u = thi * q (2 words + carry)
+    uint64_t u[3];
+    u128 uc = (u128)thi * SC_Q[0];
+    u[0] = (uint64_t)uc;
+    uc = (uc >> 64) + (u128)thi * SC_Q[1];
+    u[1] = (uint64_t)uc;
+    u[2] = (uint64_t)(uc >> 64);
+    // r = lo + u (< 2^252 + 2^131, fits 4 words)
+    u128 ac = 0;
+    for (int i = 0; i < 4; i++) {
+        ac += (u128)lo[i] + (i < 3 ? u[i] : 0);
+        r[i] = (uint64_t)ac;
+        ac >>= 64;
+    }
+    // r -= tlo; on borrow add l back (single add suffices: deficit < 2^252 < l)
+    uint64_t borrow = 0;
+    for (int i = 0; i < 4; i++) {
+        uint64_t bi = tlo[i] + borrow;
+        uint64_t carry_in = borrow && bi == 0;  // tlo[i]+borrow wrapped
+        borrow = carry_in || r[i] < bi;
+        r[i] = r[i] - bi;
+    }
+    if (borrow) sc_add_l(r);
+    while (sc_geq_l(r)) sc_sub_l(r);
+}
+
+// out = (beta * s) mod l; beta is 16 bytes LE (128-bit weight), s is a
+// canonical 32-byte scalar.  Vartime — both operands are public.
+int cpzk_sc_mul_beta(const uint8_t *beta16, const uint8_t *s32, uint8_t *out32) {
+    // domain: s < 2^253 (every canonical scalar is) — beyond that the
+    // 384-bit reduction's dropped top word goes nonzero and the result
+    // would be silently wrong; reject instead
+    if (s32[31] & 0xE0) return 0;
+    uint64_t b[2] = {load64le(beta16), load64le(beta16 + 8)};
+    uint64_t s[4];
+    for (int i = 0; i < 4; i++) s[i] = load64le(s32 + 8 * i);
+    uint64_t p[6];
+    mul_words(p, b, 2, s, 4);
+    uint64_t r[4];
+    sc_reduce384(r, p);
+    for (int i = 0; i < 4; i++) store64le(out32 + 8 * i, r[i]);
+    return 1;
+}
+
+// ---------------------------------------------------------------------------
+// vartime scalar-mul building blocks for verification
+// ---------------------------------------------------------------------------
+
+// width-5 NAF recoding: digits odd in [-15, 15] or 0; scalar < 2^253.
+// naf must hold 258 entries.
+static void recode_wnaf5(int8_t *naf, const uint8_t *s32) {
+    memset(naf, 0, 258);
+    uint64_t x[5] = {load64le(s32), load64le(s32 + 8), load64le(s32 + 16),
+                     load64le(s32 + 24), 0};
+    int i = 0;
+    while (i < 253) {  // canonical scalars are < 2^253; carries may push
+                       // digits past this index, handled below the loop
+        if (((x[i >> 6] >> (i & 63)) & 1) == 0) { i++; continue; }
+        // take 5 bits starting at i (straddles at most two words)
+        int w = (int)((x[i >> 6] >> (i & 63)) & 31);
+        if ((i & 63) > 59) w = (w | (int)(x[(i >> 6) + 1] << (64 - (i & 63)))) & 31;
+        if (w & 16) {
+            naf[i] = (int8_t)(w - 32);
+            // carry: add 2^(i+5) (bits i..i+4 are consumed by the digit)
+            int wi = (i + 5) >> 6;
+            uint64_t add = 1ULL << ((i + 5) & 63);
+            while (wi < 5) {
+                uint64_t nv = x[wi] + add;
+                x[wi] = nv;
+                if (nv >= add) break;  // no wrap -> carry absorbed
+                add = 1;
+                wi++;
+            }
+        } else {
+            naf[i] = (int8_t)w;
+        }
+        i += 5;
+    }
+    // bits at or above 253 (original top bits or ripple from a carry) are
+    // emitted as single +1 digits — always below 2^258 for our inputs
+    for (; i < 258; i++)
+        if ((x[i >> 6] >> (i & 63)) & 1) naf[i] = 1;
+}
+
+// odd multiples {1,3,...,15} * P for the wNAF5 ladder
+static void wnaf_table(ge T[8], const ge &P) {
+    T[0] = P;
+    ge P2;
+    ge_double(P2, P);
+    for (int k = 1; k < 8; k++) ge_add(T[k], T[k - 1], P2);
+}
+
+// signed radix-256 recoding: 32 digits in [-128, 127]; scalar < 2^253 so
+// the top digit absorbs the final carry without overflow
+static void recode_s256(int16_t d[32], const uint8_t *s32) {
+    int carry = 0;
+    for (int i = 0; i < 32; i++) {
+        int v = s32[i] + carry;
+        if (v >= 128 && i < 31) {
+            d[i] = (int16_t)(v - 256);
+            carry = 1;
+        } else {
+            d[i] = (int16_t)v;
+            carry = 0;
+        }
+    }
+}
+
+// ---------------------------------------------------------------------------
+// cached verification context for a generator pair
+// ---------------------------------------------------------------------------
+//
+// Built once per (g, h) pair and reused across calls: decoded points,
+// 4-bit Straus tables for the exact per-equation path, and vartime
+// signed radix-256 comb tables (tbl[i][j] = (j+1) * 256^i * B, j in
+// 0..127) that evaluate the fixed-base terms s*G and (beta*s)*H in ~32
+// adds each with ZERO doublings.  ~1.3 MiB static — a server working set,
+// built in ~2 ms on first use.
+
+struct vcomb {
+    gep tbl[32][128];
+};
+
+struct verify_ctx {
+    uint8_t gw[32], hw[32];
+    ge G, H;
+    ge tbG16[16], tbH16[16];
+    vcomb combG, combH;
+    int ready;
+};
+
+static verify_ctx VCTX = {};
+static pthread_rwlock_t VCTX_LOCK = PTHREAD_RWLOCK_INITIALIZER;
+
+static void vcomb_build(vcomb &t, const ge &base) {
+    const int N = 32 * 128;
+    ge *tmp = (ge *)malloc(sizeof(ge) * N);
+    fe *prefix = (fe *)malloc(sizeof(fe) * N);
+    ge cur = base;  // 256^i * B
+    for (int i = 0; i < 32; i++) {
+        tmp[i * 128] = cur;
+        for (int j = 1; j < 128; j++)
+            ge_add(tmp[i * 128 + j], tmp[i * 128 + j - 1], cur);
+        if (i < 31) {
+            for (int k = 0; k < 8; k++) ge_double(cur, cur);  // 256^(i+1) * B
+        }
+    }
+    // batch-normalize to affine (one inversion via Montgomery's trick),
+    // then store the precomputed (y+x, y-x, 2d*x*y) form
+    prefix[0] = tmp[0].Z;
+    for (int k = 1; k < N; k++) fe_mul(prefix[k], prefix[k - 1], tmp[k].Z);
+    fe inv;
+    fe_invert(inv, prefix[N - 1]);
+    for (int k = N - 1; k >= 0; k--) {
+        fe zinv;
+        if (k > 0) {
+            fe_mul(zinv, inv, prefix[k - 1]);
+            fe_mul(inv, inv, tmp[k].Z);
+        } else {
+            zinv = inv;
+        }
+        fe x, y, xy;
+        fe_mul(x, tmp[k].X, zinv);
+        fe_mul(y, tmp[k].Y, zinv);
+        gep &o = t.tbl[k / 128][k % 128];
+        fe_add(o.ypx, y, x);
+        fe_carry(o.ypx);
+        fe_sub(o.ymx, y, x);
+        fe_carry(o.ymx);
+        fe_mul(xy, x, y);
+        fe_mul(o.t2d, xy, FE_D2);
+    }
+    free(prefix);
+    free(tmp);
+}
+
+// vartime read: acc += sum_i digits[i] * 256^i * B
+static void vcomb_accum(ge &acc, const vcomb &t, const uint8_t *s32) {
+    int16_t d[32];
+    recode_s256(d, s32);
+    for (int i = 0; i < 32; i++) {
+        if (d[i] == 0) continue;
+        int mag = d[i] < 0 ? -d[i] : d[i];
+        ge r;
+        if (d[i] < 0) {
+            gep n;
+            gep_neg(n, t.tbl[i][mag - 1]);
+            ge_madd(r, acc, n);
+        } else {
+            ge_madd(r, acc, t.tbl[i][mag - 1]);
+        }
+        acc = r;
+    }
+}
 
 // 1..15 multiples table for the Straus ladder (slot 0 = identity)
 static void straus_table(ge tb[16], const ge &B) {
@@ -599,6 +945,190 @@ static int cp_check_eq(const ge tb[16], const ge &Y, const ge &R,
     return ge_is_identity(acc);
 }
 
+// OS entropy for the merge weight; not security-critical beyond batch
+// soundness (a failed draw just disables the merged fast path).  This is
+// on the single-verify hot path, so: getrandom(2)/arc4random first, and
+// the /dev/urandom fallback keeps one unbuffered fd for the process.
+static int fill_random16(uint8_t out[16]) {
+#if defined(__APPLE__)
+    arc4random_buf(out, 16);
+    return 1;
+#else
+#if defined(__linux__)
+    if (getrandom(out, 16, 0) == 16) return 1;
+#endif
+    static int urandom_fd = -2;  // -2 unopened, -1 failed
+    if (urandom_fd == -2) urandom_fd = open("/dev/urandom", O_RDONLY);
+    if (urandom_fd < 0) return 0;
+    return read(urandom_fd, out, 16) == 16;
+#endif
+}
+
+// one ladder step for a wNAF digit against an odd-multiples table
+static void wnaf_step(ge &acc, const ge T[8], int8_t d) {
+    if (!d) return;
+    ge t;
+    const ge &e = T[(d < 0 ? -d : d) >> 1];
+    if (d > 0) {
+        ge_add(t, acc, e);
+    } else {
+        ge n;
+        ge_neg(n, e);
+        ge_add(t, acc, n);
+    }
+    acc = t;
+}
+
+// Merged verification of one proof with a random 128-bit weight beta:
+//     s*G + (beta*s)*H - c*Y1 - (beta*c)*Y2 - R1 - beta*R2 == identity
+// which is eq1 + beta*eq2 for the two Chaum-Pedersen equations.  A proof
+// failing either equation passes only with probability ~2^-128 over beta
+// (the caller re-checks failures with the exact per-equation path, so the
+// observable accept/reject verdicts match the reference's).  Cost: ONE
+// shared-doubling ladder for the whole proof — the fixed-base terms read
+// the cached radix-256 combs with no doublings at all.
+static int cp_check_merged(const verify_ctx &ctx, const ge &Y1, const ge &Y2,
+                           const ge &R1, const ge &R2,
+                           const uint8_t *s, const uint8_t *c,
+                           const uint8_t beta16[16]) {
+    // the radix-256/wNAF recoders assume scalars < 2^253; canonical
+    // inputs always are, but this ABI is callable with arbitrary bytes —
+    // defer those to the exact path (which handles any 256-bit value)
+    // rather than index past a comb-table row
+    if ((s[31] & 0xE0) || (c[31] & 0xE0)) return 0;
+    uint8_t beta32[32] = {0};
+    memcpy(beta32, beta16, 16);
+    uint8_t bs[32], bc[32];
+    cpzk_sc_mul_beta(beta16, s, bs);
+    cpzk_sc_mul_beta(beta16, c, bc);
+
+    // fixed-base part: s*G + (beta*s)*H, adds only
+    ge fixed;
+    ge_identity(fixed);
+    vcomb_accum(fixed, ctx.combG, s);
+    vcomb_accum(fixed, ctx.combH, bs);
+
+    // variable-base part: one ladder over c*(-Y1) + bc*(-Y2) + beta*(-R2)
+    ge nY1, nY2, nR2;
+    ge_neg(nY1, Y1);
+    ge_neg(nY2, Y2);
+    ge_neg(nR2, R2);
+    ge TY1[8], TY2[8], TR2[8];
+    wnaf_table(TY1, nY1);
+    wnaf_table(TY2, nY2);
+    wnaf_table(TR2, nR2);
+    int8_t nc[258], nbc[258], nb[258];
+    recode_wnaf5(nc, c);
+    recode_wnaf5(nbc, bc);
+    recode_wnaf5(nb, beta32);
+
+    int top = 257;
+    while (top >= 0 && !nc[top] && !nbc[top] && !nb[top]) top--;
+    ge acc;
+    ge_identity(acc);
+    for (int i = top; i >= 0; i--) {
+        ge_double(acc, acc);
+        wnaf_step(acc, TY1, nc[i]);
+        wnaf_step(acc, TY2, nbc[i]);
+        wnaf_step(acc, TR2, nb[i]);
+    }
+    ge nR1;
+    ge_neg(nR1, R1);
+    ge_add(acc, acc, fixed);
+    ge_add(acc, acc, nR1);
+    return ge_is_identity(acc);
+}
+
+// Ensure VCTX matches this generator pair; returns 1 when the cached
+// context is usable (caller then reads it under its own read lock).
+// The ~4 ms table build only happens for a pair seen on two consecutive
+// misses — a one-off (or alternating) foreign pair takes the per-call
+// local-table path instead of thrashing the shared context.
+static int vctx_ensure(const uint8_t *g_wire, const uint8_t *h_wire) {
+    static uint8_t last_miss[64];
+    static int have_miss = 0;
+    static pthread_mutex_t MISS_LOCK = PTHREAD_MUTEX_INITIALIZER;
+    pthread_rwlock_rdlock(&VCTX_LOCK);
+    int ok = VCTX.ready && memcmp(VCTX.gw, g_wire, 32) == 0 &&
+             memcmp(VCTX.hw, h_wire, 32) == 0;
+    pthread_rwlock_unlock(&VCTX_LOCK);
+    if (ok) {
+        // a hit clears the miss-streak: "two CONSECUTIVE misses" is what
+        // promotes a pair, so alternating pairs (hit between misses)
+        // never rebuild and keep taking the per-call local-table path.
+        // (Unconditional lock: once per cpzk_verify_rows call, not per
+        // row — and a bare flag read would race the miss-path writes.)
+        pthread_mutex_lock(&MISS_LOCK);
+        have_miss = 0;
+        pthread_mutex_unlock(&MISS_LOCK);
+        return 1;
+    }
+    ge G, H;
+    if (!ge_decode(G, g_wire) || !ge_decode(H, h_wire)) return 0;
+    pthread_rwlock_wrlock(&VCTX_LOCK);
+    // re-check under the write lock (another thread may have built it)
+    if (VCTX.ready && memcmp(VCTX.gw, g_wire, 32) == 0 &&
+        memcmp(VCTX.hw, h_wire, 32) == 0) {
+        pthread_rwlock_unlock(&VCTX_LOCK);
+        return 1;
+    }
+    pthread_mutex_lock(&MISS_LOCK);
+    int repeat = have_miss && memcmp(last_miss, g_wire, 32) == 0 &&
+                 memcmp(last_miss + 32, h_wire, 32) == 0;
+    if (!repeat && VCTX.ready) {
+        memcpy(last_miss, g_wire, 32);
+        memcpy(last_miss + 32, h_wire, 32);
+        have_miss = 1;
+        pthread_mutex_unlock(&MISS_LOCK);
+        pthread_rwlock_unlock(&VCTX_LOCK);
+        return 0;  // caller uses per-call tables this time
+    }
+    have_miss = 0;
+    pthread_mutex_unlock(&MISS_LOCK);
+    VCTX.ready = 0;
+    VCTX.G = G;
+    VCTX.H = H;
+    straus_table(VCTX.tbG16, G);
+    straus_table(VCTX.tbH16, H);
+    vcomb_build(VCTX.combG, G);
+    vcomb_build(VCTX.combH, H);
+    memcpy(VCTX.gw, g_wire, 32);
+    memcpy(VCTX.hw, h_wire, 32);
+    VCTX.ready = 1;
+    pthread_rwlock_unlock(&VCTX_LOCK);
+    return 1;
+}
+
+// Small decode cache for repeat statements — the serving pattern is the
+// same user's y1/y2 decoding on every login, and a decode costs a full
+// field exponentiation.  Direct-mapped, consulted only for small-n calls
+// (large batches have mostly-distinct users and would just thrash it).
+struct dcache_slot {
+    uint8_t wire[32];
+    ge p;
+    int valid;
+};
+static dcache_slot DCACHE[64];
+static pthread_mutex_t DCACHE_LOCK = PTHREAD_MUTEX_INITIALIZER;
+
+static int ge_decode_cached(ge &out, const uint8_t *wire) {
+    int idx = wire[0] & 63;
+    pthread_mutex_lock(&DCACHE_LOCK);
+    if (DCACHE[idx].valid && memcmp(DCACHE[idx].wire, wire, 32) == 0) {
+        out = DCACHE[idx].p;
+        pthread_mutex_unlock(&DCACHE_LOCK);
+        return 1;
+    }
+    pthread_mutex_unlock(&DCACHE_LOCK);
+    if (!ge_decode(out, wire)) return 0;
+    pthread_mutex_lock(&DCACHE_LOCK);
+    memcpy(DCACHE[idx].wire, wire, 32);
+    DCACHE[idx].p = out;
+    DCACHE[idx].valid = 1;
+    pthread_mutex_unlock(&DCACHE_LOCK);
+    return 1;
+}
+
 struct row_job {
     const uint8_t *g, *h;          // 32B each (shared generators)
     const uint8_t *y1, *y2, *r1, *r2, *s, *c;  // n x 32B arrays
@@ -606,9 +1136,30 @@ struct row_job {
     size_t n;
     size_t next;           // work index (mutex-guarded)
     pthread_mutex_t lock;
-    ge tbG[16], tbH[16];   // shared Straus tables for the generators
+    ge tbG[16], tbH[16];   // per-call Straus tables (fallback path, lazy)
+    int tb_built;
     int gh_ok;
+    int use_ctx;           // cached verify_ctx matches this g/h pair
+    int have_beta;
+    uint8_t beta[16];
 };
+
+// Fallback when the cached context is unavailable (build failure or
+// generator churn mid-batch): per-call tables, built once under the lock.
+static int ensure_local_tables(row_job *job) {
+    pthread_mutex_lock(&job->lock);
+    if (!job->tb_built) {
+        ge G, H;
+        job->gh_ok = ge_decode(G, job->g) && ge_decode(H, job->h);
+        if (job->gh_ok) {
+            straus_table(job->tbG, G);
+            straus_table(job->tbH, H);
+        }
+        job->tb_built = 1;
+    }
+    pthread_mutex_unlock(&job->lock);
+    return job->gh_ok;
+}
 
 static void *row_worker(void *arg) {
     row_job *job = (row_job *)arg;
@@ -619,14 +1170,41 @@ static void *row_worker(void *arg) {
         if (i >= job->n) return nullptr;
 
         ge y1, y2, r1, r2;
-        if (!job->gh_ok ||
-            !ge_decode(y1, job->y1 + 32 * i) || !ge_decode(y2, job->y2 + 32 * i) ||
+        // statements repeat across logins -> cached decode for small
+        // calls; commitments are fresh randomness every proof
+        int small = job->n <= 4;
+        int ok_y = small
+            ? ge_decode_cached(y1, job->y1 + 32 * i) &&
+              ge_decode_cached(y2, job->y2 + 32 * i)
+            : ge_decode(y1, job->y1 + 32 * i) && ge_decode(y2, job->y2 + 32 * i);
+        if (!ok_y ||
             !ge_decode(r1, job->r1 + 32 * i) || !ge_decode(r2, job->r2 + 32 * i)) {
             job->out[i] = 0;
             continue;
         }
         const uint8_t *s = job->s + 32 * i;
         const uint8_t *c = job->c + 32 * i;
+
+        if (job->use_ctx) {
+            pthread_rwlock_rdlock(&VCTX_LOCK);
+            if (VCTX.ready && memcmp(VCTX.gw, job->g, 32) == 0 &&
+                memcmp(VCTX.hw, job->h, 32) == 0) {
+                int ok = 0;
+                if (job->have_beta)
+                    ok = cp_check_merged(VCTX, y1, y2, r1, r2, s, c, job->beta);
+                if (!ok)  // merged miss (or disabled): exact per-equation
+                    ok = cp_check_eq(VCTX.tbG16, y1, r1, s, c) &&
+                         cp_check_eq(VCTX.tbH16, y2, r2, s, c);
+                pthread_rwlock_unlock(&VCTX_LOCK);
+                job->out[i] = (uint8_t)ok;
+                continue;
+            }
+            pthread_rwlock_unlock(&VCTX_LOCK);  // churned away mid-batch
+        }
+        if (!ensure_local_tables(job)) {
+            job->out[i] = 0;
+            continue;
+        }
         job->out[i] = cp_check_eq(job->tbG, y1, r1, s, c) &&
                       cp_check_eq(job->tbH, y2, r2, s, c);
     }
@@ -647,11 +1225,20 @@ int cpzk_verify_rows(size_t n, const uint8_t *g, const uint8_t *h,
     job.n = n;
     job.next = 0;
     pthread_mutex_init(&job.lock, nullptr);
-    ge G, H;
-    job.gh_ok = ge_decode(G, g) && ge_decode(H, h);
-    if (job.gh_ok) {
-        straus_table(job.tbG, G);
-        straus_table(job.tbH, H);
+    job.tb_built = 0;
+    job.gh_ok = 0;
+    job.use_ctx = vctx_ensure(g, h);
+    if (!job.use_ctx && !ensure_local_tables(&job)) {
+        // generators fail to decode: every row is invalid
+        memset(out, 0, n);
+        pthread_mutex_destroy(&job.lock);
+        return 0;
+    }
+    job.have_beta = fill_random16(job.beta);
+    if (job.have_beta) {
+        int nz = 0;
+        for (int b = 0; b < 16; b++) nz |= job.beta[b];
+        job.have_beta = nz != 0;  // beta = 0 would ignore the h-side equation
     }
 
     if (n_threads < 1) n_threads = 1;
@@ -676,6 +1263,76 @@ int cpzk_verify_rows(size_t n, const uint8_t *g, const uint8_t *h,
     return 0;
 }
 
+// --- batched wire decode for the device data plane -------------------------
+//
+// The TPU backend marshals proof/statement points from wire bytes into
+// limb arrays; Python-side decode costs ~340 us/point (big-int inverse
+// square root), which dwarfs device compute at batch scale.  This decodes
+// n wires to extended coordinates (X|Y|Z|T, 32 canonical LE bytes each)
+// on the worker pool instead.
+
+struct decode_job {
+    const uint8_t *wires;
+    uint8_t *coords;  // n * 128 bytes
+    uint8_t *ok;      // n flags
+    size_t n;
+    size_t next;
+    pthread_mutex_t lock;
+};
+
+static void *decode_worker(void *arg) {
+    decode_job *job = (decode_job *)arg;
+    for (;;) {
+        pthread_mutex_lock(&job->lock);
+        size_t i = job->next++;
+        pthread_mutex_unlock(&job->lock);
+        if (i >= job->n) return nullptr;
+        ge p;
+        if (ge_decode(p, job->wires + 32 * i)) {
+            uint8_t *o = job->coords + 128 * i;
+            fe_tobytes(o, p.X);
+            fe_tobytes(o + 32, p.Y);
+            fe_tobytes(o + 64, p.Z);
+            fe_tobytes(o + 96, p.T);
+            job->ok[i] = 1;
+        } else {
+            memset(job->coords + 128 * i, 0, 128);
+            job->ok[i] = 0;
+        }
+    }
+}
+
+int cpzk_batch_decode(size_t n, const uint8_t *wires, uint8_t *coords,
+                      uint8_t *ok, int n_threads) {
+    decode_job job;
+    job.wires = wires;
+    job.coords = coords;
+    job.ok = ok;
+    job.n = n;
+    job.next = 0;
+    pthread_mutex_init(&job.lock, nullptr);
+    if (n_threads < 1) n_threads = 1;
+    if ((size_t)n_threads > n) n_threads = (int)n;
+    if (n_threads == 1) {
+        decode_worker(&job);
+    } else {
+        pthread_t *tids = (pthread_t *)malloc(sizeof(pthread_t) * n_threads);
+        int spawned = 0;
+        if (tids != nullptr) {
+            for (int t = 0; t < n_threads - 1; t++) {
+                if (pthread_create(&tids[spawned], nullptr, decode_worker, &job) != 0)
+                    break;
+                spawned++;
+            }
+        }
+        decode_worker(&job);
+        for (int t = 0; t < spawned; t++) pthread_join(tids[t], nullptr);
+        free(tids);
+    }
+    pthread_mutex_destroy(&job.lock);
+    return 0;
+}
+
 // --- small self-check helpers exposed for differential tests ---------------
 
 // decode -> encode round trip; returns 1 if input decodes validly
@@ -684,6 +1341,16 @@ int cpzk_point_roundtrip(const uint8_t *in, uint8_t *out) {
     if (!ge_decode(p, in)) return 0;
     ge_encode(out, p);
     return 1;
+}
+
+// validity check only — RFC 9496 decode already rejects every
+// non-canonical encoding, so no re-encode (and no field inversion) is
+// needed just to validate wire bytes (the hot ingress path: proof and
+// statement parsing).  Differential tests vs the Python oracle own the
+// decoder's correctness; cpzk_point_roundtrip stays for them.
+int cpzk_point_validate(const uint8_t *in) {
+    ge p;
+    return ge_decode(p, in);
 }
 
 // out = scalar * P (all wire bytes); returns 0 on decode failure

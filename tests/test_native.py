@@ -178,6 +178,124 @@ def test_native_verify_rows_differential():
     assert res[0] is False and res[1:] == [True] * 5
 
 
+def test_native_point_validate_differential():
+    _skip_without_ristretto()
+    from cpzk_tpu.core import _native, edwards as he, scalars as hs
+
+    for _ in range(24):
+        wire = he.ristretto_encode(
+            he.pt_scalar_mul(he.BASEPOINT, secrets.randbelow(hs.L))
+        )
+        assert _native.point_validate(wire) is True
+    # decode-only must reject exactly what the roundtrip rejects
+    assert _native.point_validate((3).to_bytes(32, "little")) is False
+    assert _native.point_validate(((he.P + 1) % 2**256).to_bytes(32, "little")) is False
+    assert _native.point_validate(b"\xff" * 32) is False
+    assert _native.point_validate(bytes(32)) is True  # identity is valid wire
+
+
+def test_native_sc_mul_beta_differential():
+    """The merged-verify weight math (beta * s mod l) against Python ints,
+    including boundary betas/scalars that stress the Barrett-style folds."""
+    _skip_without_ristretto()
+    from cpzk_tpu.core import _native, scalars as hs
+
+    cases = []
+    for _ in range(200):
+        cases.append((secrets.randbits(128), secrets.randbelow(hs.L)))
+    cases += [
+        (0, 5),
+        (1, hs.L - 1),
+        (2**128 - 1, hs.L - 1),
+        (2**128 - 1, 2**252),
+        (2**127, hs.L - 1),
+        (1, 0),
+    ]
+    for beta, s in cases:
+        out = _native.sc_mul_beta(
+            beta.to_bytes(16, "little"), s.to_bytes(32, "little")
+        )
+        assert out is not None
+        assert int.from_bytes(out, "little") == (beta * s) % hs.L, (beta, s)
+    # out-of-domain scalars (>= 2^253) are rejected, not silently wrong
+    with pytest.raises(ValueError, match="domain"):
+        _native.sc_mul_beta((1).to_bytes(16, "little"),
+                            (2**253).to_bytes(32, "little"))
+
+
+def test_verify_rows_single_equation_failures():
+    """Rows where exactly ONE of the two Chaum-Pedersen equations fails —
+    the case the beta-merged fast path must never falsely accept (it
+    falls back to the exact per-equation check on a merged miss)."""
+    _skip_without_ristretto()
+    from cpzk_tpu import Parameters, Prover, SecureRng, Transcript, Witness
+    from cpzk_tpu.core import _native
+    from cpzk_tpu.core.ristretto import Ristretto255
+
+    rng = SecureRng()
+    params = Parameters.new()
+    eb = Ristretto255.element_to_bytes
+    pr = Prover(params, Witness(Ristretto255.random_scalar(rng)))
+    proof = pr.prove_with_transcript(rng, Transcript())
+    t = Transcript()
+    t.append_parameters(eb(params.generator_g), eb(params.generator_h))
+    t.append_statement(eb(pr.statement.y1), eb(pr.statement.y2))
+    t.append_commitment(eb(proof.commitment.r1), eb(proof.commitment.r2))
+    c = t.challenge_scalar()
+
+    g, h = eb(params.generator_g), eb(params.generator_h)
+    y1, y2 = eb(pr.statement.y1), eb(pr.statement.y2)
+    r1, r2 = eb(proof.commitment.r1), eb(proof.commitment.r2)
+    s = Ristretto255.scalar_to_bytes(proof.response.s)
+    cb = Ristretto255.scalar_to_bytes(c)
+    junk = eb(Ristretto255.scalar_mul(params.generator_g,
+                                      Ristretto255.random_scalar(rng)))
+
+    assert _native.verify_rows(g, h, y1, y2, r1, r2, s, cb) == [True]
+    # eq1 holds, eq2 broken (r2 replaced by a random valid point)
+    assert _native.verify_rows(g, h, y1, y2, r1, junk, s, cb) == [False]
+    # eq2 holds, eq1 broken
+    assert _native.verify_rows(g, h, y1, y2, junk, r2, s, cb) == [False]
+
+
+def test_verify_rows_custom_generator_pairs():
+    """Non-default generator pairs rebuild the cached verify context;
+    alternating pairs (churn) must stay correct on every call."""
+    _skip_without_ristretto()
+    from cpzk_tpu import Parameters, Prover, SecureRng, Transcript, Witness
+    from cpzk_tpu.core import _native
+    from cpzk_tpu.core.ristretto import Ristretto255
+
+    rng = SecureRng()
+    eb = Ristretto255.element_to_bytes
+
+    def make(params):
+        pr = Prover(params, Witness(Ristretto255.random_scalar(rng)))
+        proof = pr.prove_with_transcript(rng, Transcript())
+        t = Transcript()
+        t.append_parameters(eb(params.generator_g), eb(params.generator_h))
+        t.append_statement(eb(pr.statement.y1), eb(pr.statement.y2))
+        t.append_commitment(eb(proof.commitment.r1), eb(proof.commitment.r2))
+        c = t.challenge_scalar()
+        return (
+            eb(params.generator_g), eb(params.generator_h),
+            eb(pr.statement.y1), eb(pr.statement.y2),
+            eb(proof.commitment.r1), eb(proof.commitment.r2),
+            Ristretto255.scalar_to_bytes(proof.response.s),
+            Ristretto255.scalar_to_bytes(c),
+        )
+
+    k = Ristretto255.random_scalar(rng)
+    base = Parameters.new()
+    custom = Parameters.with_generators(
+        Ristretto255.scalar_mul(base.generator_g, k),
+        base.generator_h,
+    )
+    a, b = make(base), make(custom)
+    for row in (a, b, a, b):  # alternate to force context churn
+        assert _native.verify_rows(*row) == [True]
+
+
 def test_cpu_backend_uses_native_rows():
     """BatchVerifier on the CpuBackend and the pure-Python oracle agree
     through the native fast path (mixed valid/invalid)."""
