@@ -1,0 +1,191 @@
+"""End-to-end serving throughput curve: gRPC -> batcher -> backend -> sessions.
+
+VERDICT r3 item 3: the kernel benches time device compute alone; this
+measures the FULL serving path at realistic batch totals — register,
+challenge issuance, proof generation (all untimed setup), then timed
+`VerifyProofBatch` RPCs (wire parse, challenge consumption, backend
+verification, per-item session issuance), against the reference analog
+`src/verifier/service.rs:407-617`.
+
+Prints one JSON line per curve point:
+    {"metric": "e2e_curve", "n": N, "grpc_pps": ..., "direct_pps": ...,
+     "platform": ..., "backend": ..., "unit": "proofs/s"}
+
+- grpc_pps  — proofs/s through the real asyncio gRPC loopback service
+              (batched RPCs of <=1000 items, reference cap parity).
+- direct_pps — proofs/s through BatchVerifier.verify alone on the same
+              backend (no RPC/session overhead); the gap is the serving
+              layer's cost.
+
+Backends: --backend cpu (native host core; the production CPU serving
+config) or tpu (device data plane; meaningful on real TPU — on the XLA
+CPU backend it is a correctness emulation ~1000x slower than silicon).
+Env: CPZK_E2E_NS (comma list), CPZK_BENCH_PLATFORM (jax platform pin).
+
+Usage: python benches/bench_e2e_curve.py [--ns 256,4096] [--backend cpu|tpu]
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+USERS = 512            # corpus users registered once
+CHALLENGES_PER_WAVE = 3  # per-user outstanding-challenge cap (state parity)
+RPC_CAP = 1000         # MAX_BATCH parity (service.rs:428-432)
+
+
+def build_corpus():
+    from cpzk_tpu import Parameters, Prover, SecureRng, Witness
+    from cpzk_tpu.core.ristretto import Ristretto255
+
+    rng = SecureRng()
+    params = Parameters.new()
+    provers = [
+        Prover(params, Witness(Ristretto255.random_scalar(rng)))
+        for _ in range(USERS)
+    ]
+    return rng, params, provers
+
+
+async def grpc_curve_point(n: int, provers, rng, backend_name: str) -> float:
+    """Total wall time of the timed verify RPCs for n proofs -> proofs/s."""
+    import grpc  # noqa: F401  (import check before server spin-up)
+
+    from cpzk_tpu import Transcript
+    from cpzk_tpu.client import AuthClient
+    from cpzk_tpu.core.ristretto import Ristretto255
+    from cpzk_tpu.server import RateLimiter, ServerState
+    from cpzk_tpu.server.service import serve
+
+    backend = None
+    batcher = None
+    if backend_name == "tpu":
+        from cpzk_tpu.ops.backend import TpuBackend
+        from cpzk_tpu.server.batching import DynamicBatcher
+
+        backend = TpuBackend()
+        batcher = DynamicBatcher(backend, max_batch=RPC_CAP, window_ms=5.0,
+                                 pipeline_depth=2)
+        batcher.start()
+
+    state = ServerState()
+    server, port = await serve(
+        state, RateLimiter(10**9, 10**9), host="127.0.0.1", port=0,
+        backend=backend, batcher=batcher,
+    )
+    eb = Ristretto255.element_to_bytes
+    timed = 0.0
+    done = 0
+    try:
+        async with AuthClient(f"127.0.0.1:{port}") as client:
+            for i, pr in enumerate(provers):
+                r = await client.register(
+                    f"u{i}", eb(pr.statement.y1), eb(pr.statement.y2))
+                assert r.success
+            while done < n:
+                wave = min(n - done, USERS * CHALLENGES_PER_WAVE)
+                ids, cids, proofs = [], [], []
+                for k in range(wave):
+                    u = k % USERS
+                    ch = await client.create_challenge(f"u{u}")
+                    cid = bytes(ch.challenge_id)
+                    t = Transcript()
+                    t.append_context(cid)
+                    proof = provers[u].prove_with_transcript(rng, t)
+                    ids.append(f"u{u}")
+                    cids.append(cid)
+                    proofs.append(proof.to_bytes())
+                for lo in range(0, wave, RPC_CAP):
+                    hi = min(lo + RPC_CAP, wave)
+                    t0 = time.perf_counter()
+                    resp = await client.verify_proof_batch(
+                        ids[lo:hi], cids[lo:hi], proofs[lo:hi])
+                    timed += time.perf_counter() - t0
+                    assert all(r.success for r in resp.results), "verify failed"
+                done += wave
+                # free session capacity for the next wave (untimed): the
+                # per-user session cap is 5, and each success mints one
+                for s in list(state._sessions):
+                    await state.revoke_session(s)
+    finally:
+        if batcher is not None:
+            await batcher.stop()
+        await server.stop(None)
+    return n / timed
+
+
+def direct_curve_point(n: int, provers, rng, params, backend_name: str) -> float:
+    """BatchVerifier.verify alone (reference batch.rs:171-183 analog)."""
+    from cpzk_tpu import BatchVerifier, Transcript
+    from cpzk_tpu.protocol.batch import BatchEntry
+
+    if backend_name == "tpu":
+        from cpzk_tpu.ops.backend import TpuBackend
+
+        backend = TpuBackend()
+    else:
+        from cpzk_tpu.protocol.batch import CpuBackend
+
+        backend = CpuBackend()
+
+    proofs = [
+        (pr.statement, pr.prove_with_transcript(rng, Transcript()))
+        for pr in provers[:64]
+    ]
+    bv = BatchVerifier(backend=backend, max_size=max(n, 1000))
+    for i in range(n):
+        st, prf = proofs[i % 64]
+        bv.entries.append(BatchEntry(params, st, prf, None))
+    t0 = time.perf_counter()
+    results = bv.verify(rng)  # per-proof error-or-None; None == accepted
+    dt = time.perf_counter() - t0
+    assert not any(r is not None for r in results)
+    return n / dt
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--ns", default=os.environ.get("CPZK_E2E_NS", ""))
+    ap.add_argument("--backend", default="cpu", choices=["cpu", "tpu"])
+    args = ap.parse_args()
+
+    plat = os.environ.get("CPZK_BENCH_PLATFORM")
+    if plat:
+        import jax
+
+        jax.config.update("jax_platforms", plat)
+
+    if args.ns:
+        ns = [int(x) for x in args.ns.split(",")]
+    else:
+        # full curve by default; CPU runs should pass --ns to stay small
+        ns = [256, 4096, 16384, 65536]
+
+    import jax
+
+    platform = jax.devices()[0].platform if args.backend == "tpu" else "host"
+
+    rng, params, provers = build_corpus()
+    for n in ns:
+        direct = direct_curve_point(n, provers, rng, params, args.backend)
+        grpc_pps = asyncio.run(grpc_curve_point(n, provers, rng, args.backend))
+        print(json.dumps({
+            "metric": "e2e_curve",
+            "n": n,
+            "grpc_pps": round(grpc_pps, 1),
+            "direct_pps": round(direct, 1),
+            "platform": platform,
+            "backend": args.backend,
+            "unit": "proofs/s",
+        }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -957,10 +957,17 @@ static int fill_random16(uint8_t out[16]) {
 #if defined(__linux__)
     if (getrandom(out, 16, 0) == 16) return 1;
 #endif
+    // fallback only (getrandom absent/failed): mutex-guarded lazy fd —
+    // concurrent verify_rows callers run GIL-free, so an unguarded
+    // lazy-init would race (leaked fds + a data race on the flag int)
     static int urandom_fd = -2;  // -2 unopened, -1 failed
+    static pthread_mutex_t URANDOM_LOCK = PTHREAD_MUTEX_INITIALIZER;
+    pthread_mutex_lock(&URANDOM_LOCK);
     if (urandom_fd == -2) urandom_fd = open("/dev/urandom", O_RDONLY);
-    if (urandom_fd < 0) return 0;
-    return read(urandom_fd, out, 16) == 16;
+    int fd = urandom_fd;
+    int ok = fd >= 0 && read(fd, out, 16) == 16;
+    pthread_mutex_unlock(&URANDOM_LOCK);
+    return ok;
 #endif
 }
 

@@ -59,10 +59,14 @@ def _elems_soa(elems: list, pad: int) -> curve.Point:
     wire-validated with lazy coordinates, so the native batch decode
     (threaded, ~9 us/point) beats materializing ``.point`` per element
     (~340 us of Python big-int decode each) by ~40x; falls back to the
-    Python path when the native core is absent."""
-    dev = curve.wires_to_device(b"".join(e.wire() for e in elems), pad)
-    if dev is not None:
-        return dev
+    Python path when the native core is absent — checked FIRST, so the
+    fallback never pays O(n) wire encodes just to learn that."""
+    from ..core import _native
+
+    if _native.load() is not None:
+        dev = curve.wires_to_device(b"".join(e.wire() for e in elems), pad)
+        if dev is not None:
+            return dev
     return _points_soa([e.point for e in elems], pad)
 
 
