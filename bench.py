@@ -453,9 +453,9 @@ def _bench_e2e(inp: _Inputs) -> None:
         bv.entries.append(BatchEntry(inp.params, st, pr, None))
 
     def once() -> bool:
-        rows = bv._rows(rng)
+        rows = bv.prepare_rows(rng)
         beta = Ristretto255.random_scalar(rng)
-        return bv._backend.verify_combined(rows, beta)
+        return bv.backend.verify_combined(rows, beta)
 
     assert once()  # warm (device compile already cached by the kernel run)
     best = float("inf")

@@ -39,8 +39,12 @@ def _argon2id(password: bytes, salt: bytes) -> bytes:
     )
 
 
+def derive_salt(user_id: str) -> bytes:
+    """Per-user Argon2 salt: SHA-256(prefix || user)[0:16] (client.rs:181-183)."""
+    return hashlib.sha256((SALT_PREFIX + user_id).encode()).digest()[:16]
+
+
 def password_to_scalar(password: str, user_id: str) -> Scalar:
-    salt = hashlib.sha256((SALT_PREFIX + user_id).encode()).digest()[:16]
-    okm = _argon2id(password.encode(), salt)
+    okm = _argon2id(password.encode(), derive_salt(user_id))
     digest = hashlib.sha512(okm + SCALAR_DST).digest()
     return Scalar(sc_from_bytes_mod_order_wide(digest))

@@ -50,8 +50,7 @@ def _pad_pow2(n: int) -> int:
 
 
 def _points_soa(points: list[edwards.Point], pad: int) -> curve.Point:
-    pts = points + [edwards.IDENTITY] * (pad - len(points))
-    return curve.points_to_device(pts)
+    return curve.points_soa(points, pad)
 
 
 def _elems_soa(elems: list, pad: int) -> curve.Point:
@@ -71,8 +70,7 @@ def _elems_soa(elems: list, pad: int) -> curve.Point:
 
 
 def _windows(values: list[int], pad: int) -> jnp.ndarray:
-    vals = values + [0] * (pad - len(values))
-    return jnp.asarray(curve.scalars_to_windows(vals))
+    return curve.scalar_windows(values, pad)
 
 
 @jax.jit
@@ -203,8 +201,17 @@ class TpuBackend(VerifierBackend):
 
     prefers_combined = True
 
-    def __init__(self, mesh_devices: int | None = None):
+    def __init__(self, mesh_devices: int | None = None,
+                 pippenger_min: int | None = None):
+        """``pippenger_min`` overrides the rowcombined->Pippenger crossover
+        for this instance (None = the module default / CPZK_PIPPENGER_MIN);
+        a constructor parameter so callers (drivers, calibration sweeps)
+        never need the env-plus-module-reload dance."""
         import threading
+
+        self._pippenger_min = (
+            PIPPENGER_MIN_ROWS if pippenger_min is None else pippenger_min
+        )
 
         self._gh_cache: dict[tuple[bytes, bytes], tuple[curve.Point, curve.Point]] = {}
         # the pipelined batcher calls verify_* from multiple worker
@@ -249,7 +256,7 @@ class TpuBackend(VerifierBackend):
         n = len(rows)
         device_rlc = os.environ.get("CPZK_DEVICE_RLC") == "1"
 
-        if n >= PIPPENGER_MIN_ROWS:
+        if n >= self._pippenger_min:
             return self._combined_pippenger(rows, beta, device_rlc)
 
         # correction row: G in slot r1 with -sum(a s), H in slot y1 with

@@ -86,6 +86,18 @@ def wires_to_device(wires: bytes, pad: int) -> Point | None:
     )
 
 
+def points_soa(points: list[host_edwards.Point], pad: int) -> Point:
+    """Identity-padded SoA limb marshal: the canonical way to build a
+    [20, pad] x 4 device batch from host points.  Shared by the backend
+    and the driver dryrun so their marshalling conventions cannot drift."""
+    return points_to_device(points + [host_edwards.IDENTITY] * (pad - len(points)))
+
+
+def scalar_windows(values: list[int], pad: int) -> jnp.ndarray:
+    """Zero-padded window decomposition of a scalar batch (device array)."""
+    return jnp.asarray(scalars_to_windows(values + [0] * (pad - len(values))))
+
+
 def points_from_device(pt: Point) -> list[host_edwards.Point]:
     coords = [limbs.limbs_to_ints(np.asarray(c)) for c in pt]
     return list(zip(*coords))

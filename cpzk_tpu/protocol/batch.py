@@ -302,7 +302,17 @@ class BatchVerifier:
 
     # --- verification ---
 
-    def _rows(self, rng: SecureRng) -> list[BatchRow]:
+    @property
+    def backend(self) -> VerifierBackend:
+        """The backend this batch will verify on (explicit or default)."""
+        return self._backend or default_backend()
+
+    def prepare_rows(self, rng: SecureRng) -> list[BatchRow]:
+        """Derive the backend-facing rows: per-entry Fiat-Shamir challenge
+        (batched transcript derivation) plus a fresh random RLC weight
+        alpha per row.  Public seam for benchmarks and drivers that time
+        or shard the backend stage directly (``verify`` composes this
+        with the combined-check/fallback policy)."""
         from ..core.transcript import derive_challenges_batch
 
         challenges = derive_challenges_batch(
@@ -343,8 +353,8 @@ class BatchVerifier:
         if len(self.entries) == 1:
             return [self._verify_one(0)]
 
-        backend = self._backend or default_backend()
-        rows = self._rows(rng)
+        backend = self.backend
+        rows = self.prepare_rows(rng)
 
         same_generators = all(
             r.g == rows[0].g and r.h == rows[0].h for r in rows

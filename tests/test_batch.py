@@ -72,7 +72,7 @@ def test_combined_fast_path_succeeds(params, rng):
     for _ in range(5):
         st, proof = make_entry(params, rng)
         batch.add(params, st, proof)
-    rows = batch._rows(rng)
+    rows = batch.prepare_rows(rng)
     beta = Ristretto255.random_scalar(rng)
     assert CpuBackend().verify_combined(rows, beta) is True
 

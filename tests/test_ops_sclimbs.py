@@ -120,7 +120,7 @@ def test_device_rlc_windows_match_host():
     for _ in range(3):
         p = Prover(params, Witness(Ristretto255.random_scalar(rng)))
         bv.add(params, p.statement, p.prove_with_transcript(rng, Transcript()))
-    rows = bv._rows(rng)
+    rows = bv.prepare_rows(rng)
     beta = Ristretto255.random_scalar(rng)
 
     n, b = len(rows), beta.value
