@@ -310,52 +310,48 @@ class AuthServiceImpl:
         batch = BatchVerifier(backend=self.backend)
         contexts: list[str | None] = []  # user_id when queued, error message otherwise
         error_msgs: list[str] = []
+        # stage 1: argument validation (no awaits)
+        staged: list[int] = []  # indices that passed arg validation
         for i in range(n):
-            user_id = request.user_ids[i]
-            challenge_id = request.challenge_ids[i]
-            proof_bytes = request.proofs[i]
-
-            msg = _user_id_error(user_id)
+            msg = _user_id_error(request.user_ids[i])
             if msg is None:
-                msg = _proof_args_error(challenge_id, proof_bytes, index=i)
-            if msg is not None:
-                contexts.append(None)
-                error_msgs.append(msg)
-                continue
+                msg = _proof_args_error(
+                    request.challenge_ids[i], request.proofs[i], index=i)
+            contexts.append(None)
+            error_msgs.append(msg or "")
+            if msg is None:
+                staged.append(i)
 
-            # consume BEFORE verification — single-use even on failure
-            # (service.rs:478; docs/protocol.md:174-176)
+        # stage 2: consume BEFORE verification — single-use even on failure
+        # (service.rs:478; docs/protocol.md:174-176).  Bulk state calls:
+        # one lock acquisition for all n consumes (and one for the user
+        # lookups) instead of 2n event-loop round-trips.
+        challenges = await self.state.consume_challenges(
+            [request.challenge_ids[i] for i in staged])
+        users = await self.state.get_users(
+            [request.user_ids[i] for i in staged])
+        for i, challenge, user in zip(staged, challenges, users):
+            if (
+                challenge is None
+                or challenge.user_id != request.user_ids[i]
+                or user is None
+            ):
+                error_msgs[i] = "Authentication failed"
+                continue
             try:
-                challenge = await self.state.consume_challenge(challenge_id)
-            except errors.Error:
-                contexts.append(None)
-                error_msgs.append("Authentication failed")
-                continue
-            if challenge.user_id != user_id:
-                contexts.append(None)
-                error_msgs.append("Authentication failed")
-                continue
-            user = await self.state.get_user(user_id)
-            if user is None:
-                contexts.append(None)
-                error_msgs.append("Authentication failed")
-                continue
-            try:
-                proof = Proof.from_bytes(proof_bytes)
+                proof = Proof.from_bytes(request.proofs[i])
             except errors.Error as e:
-                contexts.append(None)
-                error_msgs.append(f"Invalid proof: {e}")
+                error_msgs[i] = f"Invalid proof: {e}"
                 continue
             try:
                 batch.add_with_context(
-                    Parameters.new(), user.statement, proof, bytes(challenge_id)
+                    Parameters.new(), user.statement, proof,
+                    bytes(request.challenge_ids[i]),
                 )
             except errors.Error as e:
-                contexts.append(None)
-                error_msgs.append(f"Failed to add proof to batch: {e}")
+                error_msgs[i] = f"Failed to add proof to batch: {e}"
                 continue
-            contexts.append(user_id)
-            error_msgs.append("")
+            contexts[i] = request.user_ids[i]
 
         batch_results: list = []
         if len(batch) > 0:
@@ -398,8 +394,24 @@ class AuthServiceImpl:
                 metrics.counter("auth.verify_batch.failure").inc()
                 await context.abort(grpc.StatusCode.INTERNAL, f"Batch verification failed: {e}")
 
-        results = []
+        # session issuance for verified items — one bulk mint (single lock)
+        verified: list[int] = []
+        tokens: dict[int, str] = {}
         batch_index = 0
+        verify_errs: dict[int, object] = {}
+        for i in range(n):
+            if contexts[i] is None:
+                continue
+            verify_errs[i] = batch_results[batch_index]
+            batch_index += 1
+            if verify_errs[i] is None:
+                verified.append(i)
+                tokens[i] = self.rng.fill_bytes(32).hex()
+        session_errs = await self.state.create_sessions(
+            [(tokens[i], contexts[i]) for i in verified])
+        session_err_by_index = dict(zip(verified, session_errs))
+
+        results = []
         for i in range(n):
             user_id = contexts[i]
             if user_id is None:
@@ -408,21 +420,17 @@ class AuthServiceImpl:
                 )
                 metrics.counter("auth.verify_batch.individual_failure").inc()
                 continue
-            verify_err = batch_results[batch_index]
-            batch_index += 1
-            if verify_err is not None:
+            if verify_errs[i] is not None:
                 results.append(
                     self.pb2.VerificationResult(success=False, message="Authentication failed")
                 )
                 metrics.counter("auth.verify_batch.individual_failure").inc()
                 continue
-            token = self.rng.fill_bytes(32).hex()
-            try:
-                await self.state.create_session(token, user_id)
-            except errors.Error as e:
+            serr = session_err_by_index[i]
+            if serr is not None:
                 results.append(
                     self.pb2.VerificationResult(
-                        success=False, message=f"Failed to create session: {e}"
+                        success=False, message=f"Failed to create session: {serr}"
                     )
                 )
                 metrics.counter("auth.verify_batch.individual_failure").inc()
@@ -431,7 +439,7 @@ class AuthServiceImpl:
                 self.pb2.VerificationResult(
                     success=True,
                     message=f"User '{user_id}' authenticated successfully",
-                    session_token=token,
+                    session_token=tokens[i],
                 )
             )
             metrics.counter("auth.verify_batch.individual_success").inc()

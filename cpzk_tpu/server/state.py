@@ -128,8 +128,11 @@ class ServerState:
             self._persist_dirty = True
 
     async def get_user(self, user_id: str) -> UserData | None:
+        return (await self.get_users([user_id]))[0]
+
+    async def get_users(self, user_ids: list[str]) -> list[UserData | None]:
         async with self._lock:
-            return self._users.get(user_id)
+            return [self._users.get(u) for u in user_ids]
 
     # --- challenges (state.rs:164-249) ---
 
@@ -154,18 +157,33 @@ class ServerState:
             return self._challenges.get(challenge_id)
 
     async def consume_challenge(self, challenge_id: bytes) -> ChallengeData:
-        """Single-use removal; expired challenges are removed AND rejected."""
+        """Single-use removal; expired challenges are removed AND rejected.
+        Thin wrapper over the bulk form so the two can never desync."""
+        data = (await self.consume_challenges([challenge_id]))[0]
+        if data is None:
+            raise InvalidParams("Invalid or expired challenge")
+        return data
+
+    async def consume_challenges(self, ids: list[bytes]) -> list[ChallengeData | None]:
+        """Bulk consume-once under ONE lock acquisition (the batch RPC's
+        hot path: n sequential ``consume_challenge`` awaits cost n event-
+        loop round-trips).  Per-id semantics identical to
+        :meth:`consume_challenge`, with ``None`` standing in for the
+        invalid/expired rejection; duplicate ids in one batch behave as
+        they would sequentially (first wins)."""
         async with self._lock:
-            data = self._challenges.get(challenge_id)
-            if data is None:
-                raise InvalidParams("Invalid or expired challenge")
-            del self._challenges[challenge_id]
-            per_user = self._user_challenges.get(data.user_id)
-            if per_user is not None and challenge_id in per_user:
-                per_user.remove(challenge_id)
-            if data.is_expired():
-                raise InvalidParams("Invalid or expired challenge")
-            return data
+            out: list[ChallengeData | None] = []
+            for cid in ids:
+                data = self._challenges.get(cid)
+                if data is None:
+                    out.append(None)
+                    continue
+                del self._challenges[cid]
+                per_user = self._user_challenges.get(data.user_id)
+                if per_user is not None and cid in per_user:
+                    per_user.remove(cid)
+                out.append(None if data.is_expired() else data)
+            return out
 
     async def cleanup_expired_challenges(self) -> int:
         async with self._lock:
@@ -180,19 +198,35 @@ class ServerState:
     # --- sessions (state.rs:252-327) ---
 
     async def create_session(self, token: str, user_id: str) -> None:
+        """Thin wrapper over the bulk form so the two can never desync."""
+        msg = (await self.create_sessions([(token, user_id)]))[0]
+        if msg is not None:
+            raise InvalidParams(msg)
+
+    async def create_sessions(self, pairs: list[tuple[str, str]]) -> list[str | None]:
+        """Bulk session mint under ONE lock: per-(token, user_id) result is
+        ``None`` on success or the same error message
+        :meth:`create_session` would raise, applied in order (so caps are
+        enforced exactly as a sequential loop would)."""
         async with self._lock:
-            if len(self._sessions) >= MAX_TOTAL_SESSIONS:
-                raise InvalidParams(
-                    f"Server has reached maximum session capacity ({MAX_TOTAL_SESSIONS})"
-                )
-            per_user = self._user_sessions.setdefault(user_id, [])
-            if len(per_user) >= MAX_SESSIONS_PER_USER:
-                raise InvalidParams(
-                    f"User '{user_id}' has reached maximum session limit ({MAX_SESSIONS_PER_USER})"
-                )
-            self._sessions[token] = SessionData(token=token, user_id=user_id)
-            per_user.append(token)
-            self._persist_dirty = True
+            out: list[str | None] = []
+            for token, user_id in pairs:
+                if len(self._sessions) >= MAX_TOTAL_SESSIONS:
+                    out.append(
+                        f"Server has reached maximum session capacity ({MAX_TOTAL_SESSIONS})"
+                    )
+                    continue
+                per_user = self._user_sessions.setdefault(user_id, [])
+                if len(per_user) >= MAX_SESSIONS_PER_USER:
+                    out.append(
+                        f"User '{user_id}' has reached maximum session limit ({MAX_SESSIONS_PER_USER})"
+                    )
+                    continue
+                self._sessions[token] = SessionData(token=token, user_id=user_id)
+                per_user.append(token)
+                self._persist_dirty = True
+                out.append(None)
+            return out
 
     async def validate_session(self, token: str) -> str:
         async with self._lock:

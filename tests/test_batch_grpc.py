@@ -181,6 +181,57 @@ def test_batch_challenge_consumed_even_on_failure():
     run(flow())
 
 
+def test_batch_duplicate_challenge_id_first_wins():
+    """Two batch items sharing one challenge id: the first consumes it,
+    the second fails — single-use semantics inside one RPC (the bulk
+    consume path must behave exactly as sequential consumes did)."""
+
+    async def flow():
+        state, server, port = await start()
+        try:
+            async with AuthClient(f"127.0.0.1:{port}") as client:
+                users = await register_users(client, 1, prefix="dup")
+                ids, cids, proofs = await challenge_and_prove(client, users)
+                # same user, same challenge, same proof submitted twice
+                resp = await client.verify_proof_batch(
+                    ids * 2, cids * 2, proofs * 2)
+                assert [r.success for r in resp.results] == [True, False]
+                assert "Authentication failed" in resp.results[1].message
+                assert await state.challenge_count() == 0
+        finally:
+            await server.stop(None)
+
+    run(flow())
+
+
+def test_batch_session_cap_enforced_mid_batch():
+    """A user at the per-user session cap gets per-item session errors
+    while other items in the same batch still succeed (bulk create_sessions
+    enforces caps in order, like sequential mints did)."""
+    from cpzk_tpu.server.state import MAX_SESSIONS_PER_USER
+
+    async def flow():
+        state, server, port = await start()
+        try:
+            async with AuthClient(f"127.0.0.1:{port}") as client:
+                users = await register_users(client, 2, prefix="cap")
+                # fill user cap0's session cap via repeated logins
+                for _ in range(MAX_SESSIONS_PER_USER):
+                    ids, cids, proofs = await challenge_and_prove(client, users[:1])
+                    resp = await client.verify_proof_batch(ids, cids, proofs)
+                    assert resp.results[0].success
+                # now a batch with both users: cap0 verifies but cannot mint
+                ids, cids, proofs = await challenge_and_prove(client, users)
+                resp = await client.verify_proof_batch(ids, cids, proofs)
+                assert not resp.results[0].success
+                assert "session" in resp.results[0].message.lower()
+                assert resp.results[1].success and resp.results[1].session_token
+        finally:
+            await server.stop(None)
+
+    run(flow())
+
+
 def test_large_batch_100_users():
     async def flow():
         _, server, port = await start()
