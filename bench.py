@@ -317,6 +317,43 @@ def _run_guarded(kernel: str, e2e: bool = False,
         return None
 
 
+def _host_fallback_rate() -> float:
+    """Native host-plane batch verify at N rows (proofs/s): the honest
+    this-machine number when no accelerator is reachable.  Pure host
+    path — never touches jax, so it cannot hang on a wedged tunnel."""
+    from cpzk_tpu import BatchVerifier, Parameters, Prover, SecureRng, Transcript, Witness
+    from cpzk_tpu.core.ristretto import Ristretto255
+    from cpzk_tpu.protocol.batch import BatchEntry, CpuBackend
+
+    from cpzk_tpu.core import _native
+
+    # without the native core the pure-Python path runs ~ms/proof —
+    # shrink the row count so one iteration fits well inside the deadline
+    n_rows = N if _native.load() is not None else min(N, 2048)
+
+    rng = SecureRng()
+    params = Parameters.new()
+    proofs = []
+    for _ in range(CORPUS):
+        prover = Prover(params, Witness(Ristretto255.random_scalar(rng)))
+        proofs.append((prover.statement, prover.prove_with_transcript(rng, Transcript())))
+    bv = BatchVerifier(backend=CpuBackend(), max_size=max(n_rows, 1000))
+    for i in range(n_rows):
+        st, pr = proofs[i % CORPUS]
+        bv.entries.append(BatchEntry(params, st, pr, None))
+    assert not any(r is not None for r in bv.verify(rng))  # untimed warmup
+    best = float("inf")
+    for _ in range(max(1, ITERS - 1)):
+        t0 = time.perf_counter()
+        results = bv.verify(rng)
+        dt = time.perf_counter() - t0
+        best = min(best, dt)
+        assert not any(r is not None for r in results)
+        if _remaining() < 2 * dt + 45:  # leave room for the emit
+            break
+    return n_rows / best
+
+
 def _device_probe(timeout: float = 90) -> tuple[bool, str]:
     """One tiny device computation in a guarded subprocess: if the TPU
     tunnel is wedged, device *init* hangs forever — better to burn a
@@ -394,12 +431,20 @@ def main() -> None:
         if not plat:
             ok, reason = _probe_with_backoff()
             if not ok:
-                # VERDICT r2 item 1: still record something machine-readable
-                # (rc=0) so the round has an artifact, with a diagnostic
-                # field carrying the actual last failure instead of a bare
-                # nonzero exit.
-                _emit(0.0, diagnostic=f"device unreachable through the "
-                      f"whole probe budget; last failure: {reason}")
+                # Record something machine-readable AND real: the native
+                # host-plane batch verify rate at the same N (clearly
+                # labeled — it is NOT a TPU measurement), falling back to
+                # a 0.0 diagnostic only if even that fails.
+                try:
+                    v = _host_fallback_rate()
+                    _emit(v, diagnostic=(
+                        "TPU unreachable through the whole probe budget "
+                        f"(last failure: {reason}); value is the HOST-plane "
+                        f"native batch verify rate at N={N} on this "
+                        "container, not a device measurement"))
+                except Exception as e:  # noqa: BLE001 — artifact must land
+                    _emit(0.0, diagnostic=f"device unreachable ({reason}); "
+                          f"host fallback also failed: {e}")
                 return
         # Sequential guarded subprocesses: no device contention, and a hung
         # native compile in one kernel cannot lose the other's number.

@@ -308,7 +308,7 @@ class AuthServiceImpl:
         metrics.counter("auth.verify_batch.proofs_count").inc(n)
 
         batch = BatchVerifier(backend=self.backend)
-        contexts: list[str | None] = []  # user_id when queued, error message otherwise
+        contexts: list[str | None] = []  # user_id once queued for verify, else None
         error_msgs: list[str] = []
         # stage 1: argument validation (no awaits)
         staged: list[int] = []  # indices that passed arg validation
