@@ -317,10 +317,10 @@ def _run_guarded(kernel: str, e2e: bool = False,
         return None
 
 
-def _host_fallback_rate() -> float:
-    """Native host-plane batch verify at N rows (proofs/s): the honest
-    this-machine number when no accelerator is reachable.  Pure host
-    path — never touches jax, so it cannot hang on a wedged tunnel."""
+def _host_fallback_rate() -> tuple[float, int, bool]:
+    """Host-plane batch verify -> (proofs/s, rows measured, native?): the
+    honest this-machine number when no accelerator is reachable.  Pure
+    host path — never touches jax, so it cannot hang on a wedged tunnel."""
     from cpzk_tpu import BatchVerifier, Parameters, Prover, SecureRng, Transcript, Witness
     from cpzk_tpu.core.ristretto import Ristretto255
     from cpzk_tpu.protocol.batch import BatchEntry, CpuBackend
@@ -329,7 +329,8 @@ def _host_fallback_rate() -> float:
 
     # without the native core the pure-Python path runs ~ms/proof —
     # shrink the row count so one iteration fits well inside the deadline
-    n_rows = N if _native.load() is not None else min(N, 2048)
+    native = _native.load() is not None
+    n_rows = N if native else min(N, 2048)
 
     rng = SecureRng()
     params = Parameters.new()
@@ -351,7 +352,7 @@ def _host_fallback_rate() -> float:
         assert not any(r is not None for r in results)
         if _remaining() < 2 * dt + 45:  # leave room for the emit
             break
-    return n_rows / best
+    return n_rows / best, n_rows, native
 
 
 def _device_probe(timeout: float = 90) -> tuple[bool, str]:
@@ -436,11 +437,12 @@ def main() -> None:
                 # labeled — it is NOT a TPU measurement), falling back to
                 # a 0.0 diagnostic only if even that fails.
                 try:
-                    v = _host_fallback_rate()
+                    v, n_rows, native = _host_fallback_rate()
+                    path = "native" if native else "pure-Python"
                     _emit(v, diagnostic=(
                         "TPU unreachable through the whole probe budget "
                         f"(last failure: {reason}); value is the HOST-plane "
-                        f"native batch verify rate at N={N} on this "
+                        f"{path} batch verify rate at N={n_rows} on this "
                         "container, not a device measurement"))
                 except Exception as e:  # noqa: BLE001 — artifact must land
                     _emit(0.0, diagnostic=f"device unreachable ({reason}); "

@@ -222,16 +222,16 @@ class TpuBackend(VerifierBackend):
         self._sharded_each = None
         self._sharded_msm = None
         if mesh_devices is not None:
-            n_avail = jax.device_count()
-            want = n_avail if mesh_devices == 0 else min(mesh_devices, n_avail)
-            if want > 1:
-                from ..parallel import (
-                    batch_mesh,
-                    make_sharded_msm_check,
-                    make_sharded_verify_each,
-                )
+            from ..parallel import (
+                batch_mesh,
+                make_sharded_msm_check,
+                make_sharded_verify_each,
+                resolve_mesh_devices,
+            )
 
-                self._mesh = batch_mesh(jax.devices()[:want])
+            devices = resolve_mesh_devices(mesh_devices)
+            if devices is not None:
+                self._mesh = batch_mesh(devices)
                 self._sharded_each = make_sharded_verify_each(self._mesh)
                 self._sharded_msm = make_sharded_msm_check(self._mesh)
 

@@ -85,20 +85,34 @@ class BatchProver:
     ``Verifier`` — differential tests in ``tests/test_batch_prove.py``.
     """
 
-    def __init__(self, params: Parameters | None = None):
+    def __init__(self, params: Parameters | None = None,
+                 mesh_devices: int | None = None):
+        """``mesh_devices``: ``None`` pins single-device; ``0`` shards the
+        digit batch axis over all visible devices; ``k > 1`` over the
+        first k (pure DP — proofs are independent, no collectives)."""
         self.params = params or Parameters.new()
         g = curve.points_to_device([self.params.generator_g.point])
         h = curve.points_to_device([self.params.generator_h.point])
         build = jax.jit(_comb_tables_kernel)
         self._tg = jax.block_until_ready(build(g))
         self._th = jax.block_until_ready(build(h))
+        self._sharded = None
+        if mesh_devices is not None:
+            from ..parallel import batch_mesh, make_sharded_prove, resolve_mesh_devices
+
+            devices = resolve_mesh_devices(mesh_devices)
+            if devices is not None:
+                self._sharded = make_sharded_prove(batch_mesh(devices))
 
     def _fixed_base_bytes(self, scalars: list[int]) -> tuple[np.ndarray, np.ndarray]:
         """(P1, P2) wire bytes for (k·G, k·H) per scalar, pow2-padded jit."""
         n = len(scalars)
         pad = _pad_pow2(n)
         digits = _windows_lsb(scalars + [0] * (pad - n))
-        b1, b2 = _commitments_kernel(self._tg, self._th, digits)
+        if self._sharded is not None:
+            b1, b2 = self._sharded(self._tg, self._th, digits)
+        else:
+            b1, b2 = _commitments_kernel(self._tg, self._th, digits)
         return (
             np.asarray(b1, dtype=np.uint8)[:, :n],
             np.asarray(b2, dtype=np.uint8)[:, :n],

@@ -19,9 +19,12 @@ __all__ = [
     "batch_mesh",
     "make_sharded_combined_check",
     "make_sharded_msm_check",
+    "make_sharded_prove",
     "make_sharded_verify_each",
+    "resolve_mesh_devices",
     "sharded_combined_check",
     "sharded_msm_check",
+    "sharded_prove",
     "sharded_verify_each",
 ]
 

@@ -46,6 +46,21 @@ def batch_mesh(devices=None) -> Mesh:
     return Mesh(np.asarray(devices), (AXIS,))
 
 
+def resolve_mesh_devices(mesh_devices: int | None):
+    """The shared ``mesh_devices`` convention: ``None`` -> no mesh
+    (single-device), ``0`` -> all visible devices, ``k`` -> the first
+    min(k, visible).  Returns a device list when a real (>1) mesh should
+    be built, else None — one policy for every mesh-capable component
+    (TpuBackend, BatchProver)."""
+    if mesh_devices is None:
+        return None
+    n_avail = jax.device_count()
+    want = n_avail if mesh_devices == 0 else min(mesh_devices, n_avail)
+    if want <= 1:
+        return None
+    return jax.devices()[:want]
+
+
 def pad_to_multiple(pt: curve.Point, n_to: int) -> curve.Point:
     """Pad a [20, n] point SoA with identity rows up to n_to lanes."""
     n = pt[0].shape[-1]
@@ -117,6 +132,45 @@ def make_sharded_verify_each(mesh: Mesh):
 def sharded_verify_each(mesh: Mesh, g, h, y1, y2, r1, r2, ws, wc):
     """One-shot convenience wrapper over :func:`make_sharded_verify_each`."""
     return make_sharded_verify_each(mesh)(g, h, y1, y2, r1, r2, ws, wc)
+
+
+def make_sharded_prove(mesh: Mesh):
+    """Sharded bulk commitment generation — the proving-side DP shard
+    (BASELINE config 3 at mesh scale; reference analog
+    ``prover/mod.rs:115-121``).  Comb tables are replicated, the digit
+    batch axis is sharded, and because proofs are independent there are
+    NO collectives: pure data parallelism over the mesh.
+
+    Returns ``call(tables_g, tables_h, digits) -> (r1_bytes, r2_bytes)``
+    with digits [64, n] (LSB window first) and [32, n] wire-byte outputs.
+    Ragged batches pad with zero-digit lanes (identity commitments,
+    sliced off)."""
+    from ..ops import prove as prove_mod
+
+    rows = _row_spec()
+    fn = jax.jit(
+        shard_map(
+            prove_mod._commitments_kernel.__wrapped__,
+            mesh=mesh,
+            in_specs=(_point_specs(P()), _point_specs(P()), rows),
+            out_specs=(rows, rows),
+            check_rep=False,
+        )
+    )
+    d = mesh.devices.size
+
+    def call(tg, th, digits):
+        n = digits.shape[-1]
+        n_to = -(-n // d) * d
+        b1, b2 = fn(tg, th, pad_windows(digits, n_to))
+        return b1[:, :n], b2[:, :n]
+
+    return call
+
+
+def sharded_prove(mesh: Mesh, tg, th, digits):
+    """One-shot convenience wrapper over :func:`make_sharded_prove`."""
+    return make_sharded_prove(mesh)(tg, th, digits)
 
 
 def _combined_partial(r1, y1, r2, y2, w_a, w_ac, w_ba, w_bac):
