@@ -427,6 +427,20 @@ def main() -> None:
 
         jax.config.update("jax_platforms", plat)
 
+    # persistent XLA compile cache shared by sweep + driver runs: the
+    # pippenger program's first compile is the single biggest risk to a
+    # hardware window (minutes); pay it once per (shape, window) ever
+    try:
+        import jax
+
+        jax.config.update(
+            "jax_compilation_cache_dir",
+            os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         ".jax_bench_cache"))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 2)
+    except Exception:
+        pass  # older jax without the knob: compile-cache is best-effort
+
     if KERNEL == "auto":
         _start_watchdog()
         if not plat:
