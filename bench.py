@@ -61,6 +61,13 @@ _EMITTED = False
 # best kernel result collected so far, visible to the watchdog so a late
 # wedge cannot discard an already-measured number
 _BEST: float | None = None
+_BEST_KERNEL: str | None = None
+
+
+def _plane() -> str:
+    """Plane of a kernel measurement: "tpu" on the real device, "host"
+    when CPZK_BENCH_PLATFORM forced a CPU emulation run."""
+    return "host" if os.environ.get("CPZK_BENCH_PLATFORM") else "tpu"
 
 
 def _remaining() -> float:
@@ -238,18 +245,30 @@ def bench_rowcombined(inp: _Inputs) -> float:
     return _time_kernel(kernel, (r1, y1, r2, y2, w_a, w_ac, w_ba, w_bac))
 
 
-def _emit(value: float, diagnostic: str | None = None) -> None:
+def _emit(value: float, diagnostic: str | None = None,
+          plane: str = "tpu", kernel: str | None = None) -> None:
+    """``plane`` is machine-readable provenance (VERDICT r4 item 4): "tpu"
+    for a real device measurement, "host" for a CPU-side rate (native
+    fallback or a forced-CPU emulation run), "none" when the value is a
+    0.0 placeholder.  Without it, consumers charting rounds can only tell
+    a host number from a device number by parsing the free-text
+    diagnostic."""
     global _EMITTED
     with _EMIT_LOCK:  # exactly one JSON line, main thread or watchdog
         if _EMITTED:
             return
         _EMITTED = True
+    if value <= 0.0:
+        plane = "none"
     rec = {
         "metric": "batch_verify_proofs_per_sec",
         "value": round(value, 1),
         "unit": "proofs/s",
         "vs_baseline": round(value / BASELINE, 3),
+        "plane": plane,
     }
+    if kernel:
+        rec["kernel"] = kernel
     if diagnostic:
         rec["diagnostic"] = diagnostic
     print(json.dumps(rec), flush=True)
@@ -272,7 +291,8 @@ def _start_watchdog() -> None:
             time.sleep(slack)
         if _BEST is not None:
             _emit(_BEST, diagnostic="watchdog: deadline hit after this "
-                  "kernel finished; a later stage was still running")
+                  "kernel finished; a later stage was still running",
+                  plane=_plane(), kernel=_BEST_KERNEL)
         else:
             _emit(0.0, diagnostic="watchdog: bench hit its "
                   f"{DEADLINE_SECS}s deadline before any kernel finished")
@@ -457,7 +477,8 @@ def main() -> None:
                         "TPU unreachable through the whole probe budget "
                         f"(last failure: {reason}); value is the HOST-plane "
                         f"{path} batch verify rate at N={n_rows} on this "
-                        "container, not a device measurement"))
+                        "container, not a device measurement"),
+                        plane="host", kernel=f"host-{path}")
                 except Exception as e:  # noqa: BLE001 — artifact must land
                     _emit(0.0, diagnostic=f"device unreachable ({reason}); "
                           f"host fallback also failed: {e}")
@@ -468,27 +489,30 @@ def main() -> None:
         # number); it reserves a slice of deadline so the compile-heavy
         # pippenger still gets a chance, and an emit-worthy result exists
         # even if pippenger's window runs dry.
-        global _BEST
+        global _BEST, _BEST_KERNEL
         results = {}
         v = _run_guarded("rowcombined", e2e=True,
                          reserve=min(180.0, _remaining() / 2))
         if v is not None:
             results["rowcombined"] = _BEST = v
+            _BEST_KERNEL = "rowcombined"
         v = _run_guarded("pippenger", reserve=20.0)
         if v is not None:
             results["pippenger"] = v
-            _BEST = max(_BEST or 0.0, v)
+            if v > (_BEST or 0.0):
+                _BEST, _BEST_KERNEL = v, "pippenger"
         if not results:
             _emit(0.0, diagnostic="device reachable but no bench kernel "
                   "finished inside its guard window (wedge mid-run, or "
                   "compile exceeded the per-kernel budget)")
             return
-        _emit(max(results.values()))
+        best = max(results, key=results.get)
+        _emit(results[best], plane=_plane(), kernel=best)
         return
 
     inp = _Inputs()
     fn = {"rowcombined": bench_rowcombined, "pippenger": bench_pippenger}[KERNEL]
-    _emit(fn(inp))
+    _emit(fn(inp), plane=_plane(), kernel=KERNEL)
     if os.environ.get("CPZK_BENCH_E2E", "0") == "1":
         _bench_e2e(inp)
 

@@ -82,8 +82,12 @@ static void fe_carry(fe &h) {
 }
 
 static void fe_mul(fe &h, const fe &f, const fe &g) {
-    // donna-style: fold the 19x wrap into pre-scaled u64 factors (g[j] <
-    // 2^52, so 19*g[j] < 2^57 stays a single 64x64 product per term)
+    // donna-style: fold the 19x wrap into pre-scaled u64 factors.  Real
+    // headroom (not the tight reduced-form bound): callers routinely pass
+    // uncarried fe_add/fe_sub outputs as g (e.g. ge_add's fe_add(b, q.Y,
+    // q.X) with limbs up to ~2^56), so the requirement is g[j] < 2^59
+    // (19*g[j] < 2^64 stays a u64) and f[j] < ~2^57 (each of the 5
+    // products per accumulator < 2^123, so the u128 sums cannot wrap).
     const uint64_t f0 = f.v[0], f1 = f.v[1], f2 = f.v[2], f3 = f.v[3], f4 = f.v[4];
     const uint64_t g0 = g.v[0], g1 = g.v[1], g2 = g.v[2], g3 = g.v[3], g4 = g.v[4];
     const uint64_t g1_19 = 19 * g1, g2_19 = 19 * g2, g3_19 = 19 * g3, g4_19 = 19 * g4;
