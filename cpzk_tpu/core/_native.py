@@ -73,6 +73,12 @@ def _declare(lib: ctypes.CDLL) -> None:
             ctypes.c_size_t, ctypes.c_char_p, ctypes.c_char_p,
             ctypes.c_char_p, ctypes.c_int,
         ]
+    if hasattr(lib, "cpzk_parse_proofs"):
+        lib.cpzk_parse_proofs.restype = ctypes.c_int
+        lib.cpzk_parse_proofs.argtypes = [
+            ctypes.c_size_t, ctypes.c_char_p, ctypes.c_char_p,
+            ctypes.c_int, ctypes.c_int,
+        ]
     if hasattr(lib, "cpzk_sc_mul_beta"):
         lib.cpzk_sc_mul_beta.restype = ctypes.c_int
         lib.cpzk_sc_mul_beta.argtypes = [
@@ -97,6 +103,21 @@ def _declare(lib: ctypes.CDLL) -> None:
         ]
 
 
+# Expected cpzk_abi_version(); must match ristretto.cpp.  The loader
+# force-rebuilds once on mismatch — keyed on an explicit generation number
+# rather than symbol presence, because a changed signature or changed
+# semantics behind an existing symbol is invisible to hasattr.
+_ABI_EXPECTED = 2
+
+
+def _abi(lib: ctypes.CDLL) -> int:
+    if not hasattr(lib, "cpzk_abi_version"):
+        return 0
+    lib.cpzk_abi_version.restype = ctypes.c_int
+    lib.cpzk_abi_version.argtypes = []
+    return int(lib.cpzk_abi_version())
+
+
 def load() -> ctypes.CDLL | None:
     """The native library, or None when unavailable."""
     global _lib, _tried
@@ -114,12 +135,12 @@ def load() -> ctypes.CDLL | None:
     # discard a working (older) library — a failed rebuild keeps the old
     # file and the old capabilities.  Keyed to the NEWEST export so every
     # symbol generation triggers exactly one refresh.
-    if not hasattr(lib, "cpzk_batch_decode") and _build(force=True):
+    if _abi(lib) != _ABI_EXPECTED and _build(force=True):
         try:
             relib = ctypes.CDLL(_LIB_PATH)
         except OSError:
             relib = None
-        if relib is not None and hasattr(relib, "cpzk_batch_decode"):
+        if relib is not None and _abi(relib) == _ABI_EXPECTED:
             lib = relib
 
     _declare(lib)
@@ -182,11 +203,13 @@ def verify_rows(
     ss: bytes,
     cs: bytes,
     threads: int = 0,
-) -> list[bool] | None:
+) -> list[int] | None:
     """Verify n Chaum-Pedersen rows natively (s*G == R1 + c*Y1 and the H/Y2
     twin; reference ``verifier/mod.rs:144-171``); None if the library is
     absent.  ``g``/``h`` are the shared 32-byte generators; the six column
-    args are n*32-byte concatenations of wire encodings."""
+    args are n*32-byte concatenations of wire encodings.  Per-row status:
+    1 = pass, 0 = fail, 2 = commitment wire failed to decode (only
+    reachable with deferred-parse proofs; maps back to the parse error)."""
     lib = _ristretto_lib()
     if lib is None:
         return None
@@ -203,7 +226,7 @@ def verify_rows(
         threads = min(os.cpu_count() or 1, max(1, n))
     out = ctypes.create_string_buffer(n)
     lib.cpzk_verify_rows(n, g, h, y1s, y2s, r1s, r2s, ss, cs, out, threads)
-    return [b == 1 for b in out.raw]
+    return list(out.raw)
 
 
 def batch_decode(wires: bytes, threads: int = 0) -> tuple[bytes, bytes] | None:
@@ -224,6 +247,29 @@ def batch_decode(wires: bytes, threads: int = 0) -> tuple[bytes, bytes] | None:
         threads = min(os.cpu_count() or 1, max(1, n // 256 + 1))
     lib.cpzk_batch_decode(n, wires, coords, ok, threads)
     return coords.raw, ok.raw
+
+
+def parse_proofs(packed: bytes, deep: bool = True,
+                 threads: int = 0) -> bytes | None:
+    """Fast-path validation of n packed 109-byte proof wires (the only
+    layout a valid proof can have); returns n flag bytes — 1 means the
+    item passed, 0 means "re-parse on the Python slow path for the exact
+    error".  ``deep=True`` is complete validity (framing, canonical
+    non-identity points, canonical nonzero scalar); ``deep=False`` skips
+    the two point decodes for the deferred-parse serving path, where the
+    verify stage decodes commitments anyway and reports failures
+    tri-state.  None when the library is absent."""
+    lib = _ristretto_lib()
+    if lib is None or not hasattr(lib, "cpzk_parse_proofs"):
+        return None
+    if len(packed) % 109:
+        raise ValueError("packed must be a multiple of 109 bytes")
+    n = len(packed) // 109
+    ok = ctypes.create_string_buffer(n)
+    if threads <= 0:
+        threads = 1 if not deep else min(os.cpu_count() or 1, max(1, n // 512 + 1))
+    lib.cpzk_parse_proofs(n, packed, ok, 1 if deep else 0, threads)
+    return ok.raw
 
 
 def point_validate(wire: bytes) -> bool | None:

@@ -19,3 +19,12 @@ class InvalidScalar(Error):
 
 class InvalidGroupElement(Error):
     """Invalid group element encoding/value (reference ``Error::InvalidGroupElement``)."""
+
+
+class InvalidProofEncoding(InvalidGroupElement):
+    """A deferred-parse proof whose commitment wire failed to decode at the
+    batch-verify stage.  Same taxonomy slot as the eager parse error
+    (``InvalidGroupElement`` from ``element_from_bytes``) — the distinct
+    type lets the serving layer report the exact parse-time message
+    ("Invalid proof: ...") instead of a generic verification failure, so
+    deferred parsing is observationally identical to eager parsing."""

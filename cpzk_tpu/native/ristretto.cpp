@@ -1188,9 +1188,16 @@ static void *row_worker(void *arg) {
             ? ge_decode_cached(y1, job->y1 + 32 * i) &&
               ge_decode_cached(y2, job->y2 + 32 * i)
             : ge_decode(y1, job->y1 + 32 * i) && ge_decode(y2, job->y2 + 32 * i);
-        if (!ok_y ||
-            !ge_decode(r1, job->r1 + 32 * i) || !ge_decode(r2, job->r2 + 32 * i)) {
+        if (!ok_y) {
             job->out[i] = 0;
+            continue;
+        }
+        // tri-state: 2 = commitment wire failed to decode — the deferred-
+        // parse serving path maps this back to the exact parse error
+        // (statement wires come from registration and are always valid, so
+        // only r1/r2 can be unvalidated here)
+        if (!ge_decode(r1, job->r1 + 32 * i) || !ge_decode(r2, job->r2 + 32 * i)) {
+            job->out[i] = 2;
             continue;
         }
         const uint8_t *s = job->s + 32 * i;
@@ -1221,7 +1228,8 @@ static void *row_worker(void *arg) {
     }
 }
 
-// Verify n Chaum-Pedersen rows; returns 0 on success, out[i] in {0,1}.
+// Verify n Chaum-Pedersen rows; returns 0 on success, out[i] in {0,1,2}
+// (2 = commitment decode failure, see row_worker).
 // All inputs are 32-byte wire encodings; g/h are shared across the batch.
 int cpzk_verify_rows(size_t n, const uint8_t *g, const uint8_t *h,
                      const uint8_t *y1, const uint8_t *y2,
@@ -1344,6 +1352,12 @@ int cpzk_batch_decode(size_t n, const uint8_t *wires, uint8_t *coords,
     return 0;
 }
 
+// ABI generation for the Python loader's staleness gate: bump on ANY
+// exported-signature or exported-semantics change (not just new symbols —
+// a symbol-presence check cannot see a changed signature).
+// 2: cpzk_parse_proofs gained `deep`; cpzk_verify_rows out[] went tri-state.
+int cpzk_abi_version(void) { return 2; }
+
 // --- small self-check helpers exposed for differential tests ---------------
 
 // decode -> encode round trip; returns 1 if input decodes validly
@@ -1380,6 +1394,103 @@ int cpzk_point_add(const uint8_t *a, const uint8_t *b, uint8_t *out) {
     ge_add(r, p, q);
     ge_encode(out, r);
     return 1;
+}
+
+// --- batch proof parse fast path -------------------------------------------
+// Validates n candidate proof wires, each exactly PROOF_WIRE=109 bytes,
+// packed contiguously.  Wire layout (gadgets.py framing; the only layout a
+// valid proof can have, since every field must be exactly 32 bytes):
+//   [ver=1][00 00 00 20][r1:32][00 00 00 20][r2:32][00 00 00 20][s:32]
+// ok[i]=1 only when item i is a COMPLETE valid proof: exact framing, both
+// commitment points decode (RFC 9496 canonical — decode success is
+// validity) and are not the identity, response scalar canonical mod l and
+// nonzero.  ok[i]=0 means "re-parse on the slow path" — the Python parser
+// reproduces the reference's exact per-field error message
+// (gadgets.rs:364-489); this function only has to agree on accept/reject,
+// which tests/test_protocol.py pins differentially against Proof.from_bytes.
+
+#define PROOF_WIRE 109
+
+// deep=1: full validation including point decodes.  deep=0: frame-only —
+// everything EXCEPT the two point decodes, for the deferred-parse serving
+// path where the batch-verify stage decodes the commitments anyway (one
+// decode per point across the whole ingress+verify pipeline instead of
+// two).  A frame-only pass guarantees that the ONLY way the item can
+// still be invalid is a commitment decode failure, which is what lets the
+// verify stage's tri-state (row_worker out[i]=2) map back to the exact
+// parse error message.
+static int parse_one_proof(const uint8_t *p, int deep) {
+    static const uint8_t LEN32[4] = {0, 0, 0, 32};
+    static const uint8_t ZERO32[32] = {0};
+    if (p[0] != 1) return 0;  // PROTOCOL_VERSION (gadgets.py:17)
+    if (memcmp(p + 1, LEN32, 4) != 0 || memcmp(p + 37, LEN32, 4) != 0 ||
+        memcmp(p + 73, LEN32, 4) != 0)
+        return 0;
+    const uint8_t *r1 = p + 5, *r2 = p + 41, *s = p + 77;
+    // identity's canonical encoding is all-zero; decode would accept it
+    if (memcmp(r1, ZERO32, 32) == 0 || memcmp(r2, ZERO32, 32) == 0) return 0;
+    uint64_t sv[4];
+    for (int i = 0; i < 4; i++) sv[i] = load64le(s + 8 * i);
+    if (sc_geq_l(sv)) return 0;                    // non-canonical scalar
+    if ((sv[0] | sv[1] | sv[2] | sv[3]) == 0) return 0;  // zero response
+    if (deep) {
+        ge t;
+        if (!ge_decode(t, r1)) return 0;
+        if (!ge_decode(t, r2)) return 0;
+    }
+    return 1;
+}
+
+struct parse_job {
+    const uint8_t *wires;
+    uint8_t *ok;
+    size_t n;
+    size_t next;
+    int deep;
+    pthread_mutex_t lock;
+};
+
+static void *parse_worker(void *arg) {
+    parse_job *job = (parse_job *)arg;
+    for (;;) {
+        pthread_mutex_lock(&job->lock);
+        size_t i = job->next++;
+        pthread_mutex_unlock(&job->lock);
+        if (i >= job->n) return nullptr;
+        job->ok[i] = (uint8_t)parse_one_proof(job->wires + PROOF_WIRE * i,
+                                              job->deep);
+    }
+}
+
+int cpzk_parse_proofs(size_t n, const uint8_t *wires, uint8_t *ok,
+                      int deep, int n_threads) {
+    parse_job job;
+    job.wires = wires;
+    job.ok = ok;
+    job.n = n;
+    job.next = 0;
+    job.deep = deep;
+    pthread_mutex_init(&job.lock, nullptr);
+    if (n_threads < 1) n_threads = 1;
+    if ((size_t)n_threads > n) n_threads = (int)n;
+    if (n_threads == 1) {
+        parse_worker(&job);
+    } else {
+        pthread_t *tids = (pthread_t *)malloc(sizeof(pthread_t) * n_threads);
+        int spawned = 0;
+        if (tids != nullptr) {
+            for (int t = 0; t < n_threads - 1; t++) {
+                if (pthread_create(&tids[spawned], nullptr, parse_worker, &job) != 0)
+                    break;
+                spawned++;
+            }
+        }
+        parse_worker(&job);
+        for (int t = 0; t < spawned; t++) pthread_join(tids[t], nullptr);
+        free(tids);
+    }
+    pthread_mutex_destroy(&job.lock);
+    return 0;
 }
 
 }  // extern "C"

@@ -30,7 +30,7 @@ from __future__ import annotations
 
 from dataclasses import dataclass
 
-from ..errors import Error, InvalidParams
+from ..errors import Error, InvalidParams, InvalidProofEncoding
 from ..core import edwards
 from ..core.ristretto import Element, Ristretto255, Scalar
 from ..core.rng import SecureRng
@@ -80,12 +80,21 @@ class VerifierBackend:
     #: backends where the combined check amortizes.
     prefers_combined: bool = True
 
+    #: Whether ``verify_each`` reports a deferred-parse proof's commitment
+    #: decode failure tri-state (row status 2) instead of crashing or
+    #: conflating it with a verification failure.  When False, the
+    #: dispatcher eagerly screens deferred proofs before involving the
+    #: backend, so backends never see an undecodable wire.
+    supports_deferred_decode: bool = False
+
     def verify_combined(self, rows: list[BatchRow], beta: Scalar) -> bool:
         """Corrected-RLC combined check; True iff the whole batch passes."""
         raise NotImplementedError
 
-    def verify_each(self, rows: list[BatchRow]) -> list[bool]:
-        """Per-proof ground-truth checks (the accept-set decider)."""
+    def verify_each(self, rows: list[BatchRow]) -> list[int]:
+        """Per-proof ground-truth checks (the accept-set decider).
+        Per-row status: 1/True = pass, 0/False = fail, 2 = commitment wire
+        failed to decode (deferred-parse rows only)."""
         raise NotImplementedError
 
 
@@ -93,6 +102,7 @@ class CpuBackend(VerifierBackend):
     """Host-plane backend over the integer-exact core (the oracle)."""
 
     prefers_combined = False
+    supports_deferred_decode = True  # native rows report status 2
 
     def verify_combined(self, rows: list[BatchRow], beta: Scalar) -> bool:
         acc = edwards.IDENTITY
@@ -122,17 +132,24 @@ class CpuBackend(VerifierBackend):
         )
         return edwards.pt_eq(lhs, acc)
 
-    def verify_each(self, rows: list[BatchRow]) -> list[bool]:
+    def verify_each(self, rows: list[BatchRow]) -> list[int]:
         native = self._verify_each_native(rows)
         if native is not None:
             return native
-        out = []
+        out: list[int] = []
         for row in rows:
+            try:
+                r1p, r2p = row.r1.point, row.r2.point
+            except Error:
+                # deferred-parse wire that fails to decode (tri-state twin
+                # of the native path's status 2)
+                out.append(2)
+                continue
             lhs1 = edwards.pt_scalar_mul(row.g.point, row.s.value)
-            rhs1 = edwards.pt_add(row.r1.point, edwards.pt_scalar_mul(row.y1.point, row.c.value))
+            rhs1 = edwards.pt_add(r1p, edwards.pt_scalar_mul(row.y1.point, row.c.value))
             lhs2 = edwards.pt_scalar_mul(row.h.point, row.s.value)
-            rhs2 = edwards.pt_add(row.r2.point, edwards.pt_scalar_mul(row.y2.point, row.c.value))
-            out.append(edwards.pt_eq(lhs1, rhs1) and edwards.pt_eq(lhs2, rhs2))
+            rhs2 = edwards.pt_add(r2p, edwards.pt_scalar_mul(row.y2.point, row.c.value))
+            out.append(int(edwards.pt_eq(lhs1, rhs1) and edwards.pt_eq(lhs2, rhs2)))
         return out
 
     @staticmethod
@@ -347,18 +364,52 @@ class BatchVerifier:
         Mirrors batch.rs:171-183: empty batch is an error; n == 1 verifies
         individually; otherwise the combined check decides the fast path and
         failure falls back to per-proof results.
+
+        Deferred-parse proofs (see :meth:`Proof.from_bytes_batch`) settle
+        their postponed commitment decodes here: backends that report
+        decode failures tri-state handle them in the same pass as
+        verification; otherwise (and always for n == 1 or the combined
+        fast path) they are screened eagerly first, so every path yields
+        the exact eager-parse error for an undecodable wire.
         """
         if not self.entries:
             raise InvalidParams("Cannot verify empty batch")
-        if len(self.entries) == 1:
+        n = len(self.entries)
+        backend = self.backend
+        same_generators = all(
+            e.params.generator_g == self.entries[0].params.generator_g
+            and e.params.generator_h == self.entries[0].params.generator_h
+            for e in self.entries
+        )
+        has_deferred = any(e.proof.deferred for e in self.entries)
+        if has_deferred and (
+            n == 1
+            or not same_generators
+            or not backend.supports_deferred_decode
+            or backend.prefers_combined
+        ):
+            pre_errors = self._screen_deferred()
+            if pre_errors:
+                # keep undecodable wires away from the backend: verify the
+                # survivors as their own batch and splice results back
+                sub = BatchVerifier(backend=self._backend,
+                                    max_size=max(self.max_size, 1))
+                sub.entries = [e for i, e in enumerate(self.entries)
+                               if i not in pre_errors]
+                sub_results = sub.verify(rng) if sub.entries else []
+                results, k = [], 0
+                for i in range(n):
+                    if i in pre_errors:
+                        results.append(pre_errors[i])
+                    else:
+                        results.append(sub_results[k])
+                        k += 1
+                return results
+
+        if n == 1:
             return [self._verify_one(0)]
 
-        backend = self.backend
         rows = self.prepare_rows(rng)
-
-        same_generators = all(
-            r.g == rows[0].g and r.h == rows[0].h for r in rows
-        )
         beta = Ristretto255.random_scalar(rng)
         if (
             same_generators
@@ -368,10 +419,49 @@ class BatchVerifier:
             return [None] * len(rows)
 
         # Fallback: per-proof ground truth (batch.rs:314-318)
-        results: list[Error | None] = []
+        results = []
         for ok in backend.verify_each(rows):
-            results.append(None if ok else InvalidParams("Proof verification failed"))
+            if ok == 2:  # deferred commitment wire failed to decode
+                results.append(InvalidProofEncoding(
+                    "Bytes do not represent a valid Ristretto point"))
+            elif ok:
+                results.append(None)
+            else:
+                results.append(InvalidParams("Proof verification failed"))
         return results
+
+    def _screen_deferred(self) -> dict[int, Error]:
+        """Settle deferred proofs' postponed point decodes eagerly: one
+        native deep parse over just the deferred wires.  Survivors are
+        promoted to fully-validated (``deferred`` cleared, elements marked
+        canonical); failures map to the exact eager-parse error."""
+        idxs = [i for i, e in enumerate(self.entries) if e.proof.deferred]
+        out: dict[int, Error] = {}
+        if not idxs:
+            return out
+        from ..core import _native
+
+        packed = b"".join(self.entries[i].proof.to_bytes() for i in idxs)
+        flags = _native.parse_proofs(packed)  # deep: includes the decodes
+        for j, i in enumerate(idxs):
+            proof = self.entries[i].proof
+            if flags is not None:
+                ok = bool(flags[j])
+            else:  # no native core: settle through the Python decoder
+                try:
+                    _ = proof.commitment.r1.point
+                    _ = proof.commitment.r2.point
+                    ok = True
+                except Error:
+                    ok = False
+            if ok:
+                proof.deferred = False
+                proof.commitment.r1._validated = True
+                proof.commitment.r2._validated = True
+            else:
+                out[i] = InvalidProofEncoding(
+                    "Bytes do not represent a valid Ristretto point")
+        return out
 
     def _verify_one(self, index: int) -> Error | None:
         entry = self.entries[index]

@@ -11,10 +11,16 @@ from __future__ import annotations
 
 from dataclasses import dataclass
 
-from ..errors import InvalidParams
+from ..errors import Error, InvalidParams
+from ..core import _native
 from ..core.ristretto import Element, Ristretto255, Scalar
 
 PROTOCOL_VERSION = 1
+
+# The one wire size a VALID proof can have: ver(1) + 3 × [len u32 + 32-byte
+# field].  Other sizes still parse (and fail) through the framing loop so
+# the reference's per-field error messages are preserved.
+PROOF_WIRE_SIZE = 1 + 3 * (4 + 32)
 
 
 def frame_fields(version: int, *fields: bytes) -> bytes:
@@ -122,14 +128,24 @@ class Response:
 
 
 class Proof:
-    """Complete NIZK proof: version + commitment + response (gadgets.rs:306-489)."""
+    """Complete NIZK proof: version + commitment + response (gadgets.rs:306-489).
 
-    __slots__ = ("version", "commitment", "response")
+    ``deferred`` marks a proof built by the frame-only fast parse
+    (:meth:`from_bytes_batch` with ``defer_point_validation=True``): the
+    framing, scalar, and identity rules are already enforced, but the two
+    commitment point decodes are postponed to the batch-verify stage,
+    which decodes them anyway (one decode per point across ingress+verify
+    instead of two).  ``BatchVerifier`` screens or tri-state-maps deferred
+    proofs so accept/reject and error messages are identical to eager
+    parsing."""
+
+    __slots__ = ("version", "commitment", "response", "deferred")
 
     def __init__(self, commitment: Commitment, response: Response, version: int = PROTOCOL_VERSION):
         self.version = version
         self.commitment = commitment
         self.response = response
+        self.deferred = False
 
     def to_bytes(self) -> bytes:
         """Wire format: ``[ver u8][len u32_be|r1][len|r2][len|s]`` = 109 bytes."""
@@ -141,8 +157,94 @@ class Proof:
         )
 
     @staticmethod
+    def _from_validated_wire(data: bytes) -> "Proof":
+        """Construct from a PROOF_WIRE_SIZE wire that the native fast-path
+        parser already validated end to end (framing, canonical non-identity
+        points, canonical nonzero scalar).  Skips re-validation and the
+        ``Scalar.__init__`` reduction — the parser guarantees s < l."""
+        s = Scalar.__new__(Scalar)
+        s.value = int.from_bytes(data[77:109], "little")
+        resp = Response.__new__(Response)
+        resp._s = s
+        return Proof(
+            Commitment(Element(wire=data[5:37], validated=True),
+                       Element(wire=data[41:73], validated=True)),
+            resp,
+        )
+
+    @staticmethod
+    def _from_framed_wire(data: bytes) -> "Proof":
+        """Construct from a frame-checked wire whose POINT decodes are
+        deferred to the verify stage (commitment elements stay
+        unvalidated; the scalar is already proven canonical)."""
+        s = Scalar.__new__(Scalar)
+        s.value = int.from_bytes(data[77:109], "little")
+        resp = Response.__new__(Response)
+        resp._s = s
+        p = Proof(
+            Commitment(Element(wire=data[5:37]), Element(wire=data[41:73])),
+            resp,
+        )
+        p.deferred = True
+        return p
+
+    @staticmethod
+    def from_bytes_batch(
+        items: "list[bytes]", defer_point_validation: bool = False
+    ) -> "list[Proof | Error]":
+        """Parse n proof wires with ONE native validation call for the
+        whole batch (``cpzk_parse_proofs`` worker pool) instead of per-item
+        decode round-trips — the serving path's ingress cost.  Per-item
+        result is a :class:`Proof` or the :class:`~cpzk_tpu.errors.Error`
+        that :meth:`from_bytes` raises for it: items the fast path rejects
+        (wrong size, bad framing, invalid point/scalar) re-parse on the
+        Python slow path so error-message parity with the reference
+        (gadgets.rs:364-489) is byte-exact.
+
+        ``defer_point_validation=True`` skips the two commitment point
+        decodes here and returns ``deferred`` proofs (see :class:`Proof`);
+        only hand those to a :class:`~cpzk_tpu.protocol.batch.BatchVerifier`,
+        which settles the postponed decodes with exact error parity."""
+        n = len(items)
+        results: list = [None] * n
+        sized = [i for i in range(n) if len(items[i]) == PROOF_WIRE_SIZE]
+        if sized:
+            packed = b"".join(bytes(items[i]) for i in sized)
+            flags = _native.parse_proofs(packed, deep=not defer_point_validation)
+            if flags is not None:
+                build = (Proof._from_framed_wire if defer_point_validation
+                         else Proof._from_validated_wire)
+                for j, i in enumerate(sized):
+                    if flags[j]:
+                        results[i] = build(bytes(items[i]))
+        for i in range(n):
+            if results[i] is None:
+                # straight to the slow parser: the batched native pass
+                # already rejected (or never applies to) this item, so
+                # from_bytes' fast path would just repeat that work
+                try:
+                    results[i] = Proof._from_bytes_slow(items[i])
+                except Error as e:
+                    results[i] = e
+        return results
+
+    @staticmethod
     def from_bytes(data: bytes) -> "Proof":
         """Full adversarial-input validation (gadgets.rs:364-489)."""
+        if len(data) == PROOF_WIRE_SIZE:
+            # one native call validates everything; a 0 flag falls through
+            # to the framing loop for the exact error message
+            flags = _native.parse_proofs(bytes(data), threads=1)
+            if flags == b"\x01":
+                return Proof._from_validated_wire(bytes(data))
+        return Proof._from_bytes_slow(data)
+
+    @staticmethod
+    def _from_bytes_slow(data: bytes) -> "Proof":
+        """The Python reference parser: full per-field validation with the
+        reference's exact error messages.  ``from_bytes`` minus the native
+        fast path — call directly when the native pass already rejected
+        this wire (avoids re-running its two point decodes)."""
         if len(data) < MIN_PROOF_SIZE:
             raise InvalidParams(f"Proof too small: {len(data)} bytes")
 

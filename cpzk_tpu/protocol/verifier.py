@@ -67,7 +67,7 @@ class Verifier:
             threads=1,
         )
         if native is not None:
-            if not native[0]:
+            if native[0] != 1:  # 0 = fail, 2 = commitment decode failure
                 raise InvalidParams("Proof verification failed")
             return
 

@@ -97,20 +97,63 @@ class DynamicBatcher:
     ) -> Error | None:
         """Queue one proof; resolves to ``None`` (ok) or the ``Error``."""
         entry = BatchEntry(params, statement, proof, context)
+        return (await self.submit_many([entry]))[0]
+
+    async def submit_many(
+        self, entries: list[BatchEntry]
+    ) -> list[Error | None]:
+        """Queue a whole RPC's entries in one enqueue: one capacity check,
+        one wakeup, and futures created without a coroutine per item —
+        the per-item scheduling cost is the serving layer's, not the
+        device's, so batch RPCs bypass it.  All-or-nothing on
+        backpressure: either every entry is queued or ``QueueFull`` is
+        raised before any is (no orphaned siblings to drain).  Entries may
+        still be split across device batches at ``max_batch`` boundaries
+        or coalesced with concurrent RPCs — per-entry results are awaited
+        together and returned in order."""
+        if not entries:
+            return []
         if self._stopping or self._task is None or self._task.done():
             # shutdown window (stop() ran but the listener is still up) or
             # batcher never started: verify inline with identical semantics
-            return (await asyncio.to_thread(self._verify, [entry]))[0]
-        if len(self._queue) >= self.max_queue:
+            return await asyncio.to_thread(self._verify, entries)
+        if len(self._queue) + len(entries) > self.max_queue:
             metrics.counter("tpu.queue.shed").inc()
             raise QueueFull(
                 f"verification queue at capacity ({self.max_queue} entries)"
             )
-        fut = asyncio.get_running_loop().create_future()
-        self._queue.append((entry, fut))
+        loop = asyncio.get_running_loop()
+        futs = [loop.create_future() for _ in entries]
+        self._queue.extend(zip(entries, futs))
         metrics.gauge("tpu.queue.depth").set(len(self._queue))
         self._wakeup.set()
-        return await fut
+        # Futures resolve to an Error VALUE for a per-entry verification
+        # failure and to a raised exception only for dispatch blowups —
+        # gather(return_exceptions=True) would conflate the two, and plain
+        # gather would leave sibling exceptions unretrieved (log flood).
+        # wait + explicit .exception() keeps the distinction and marks
+        # every sibling's exception retrieved before the first propagates.
+        try:
+            await asyncio.wait(futs)
+        except asyncio.CancelledError:
+            # RPC cancelled while queued: cancel our futures so a later
+            # dispatch failure doesn't set never-retrieved exceptions on
+            # them (_dispatch skips done futures)
+            for fut in futs:
+                fut.cancel()
+            raise
+        first_exc: BaseException | None = None
+        results: list[Error | None] = []
+        for fut in futs:
+            exc = fut.exception()
+            if exc is not None:
+                first_exc = first_exc or exc
+                results.append(None)
+            else:
+                results.append(fut.result())
+        if first_exc is not None:
+            raise first_exc
+        return results
 
     # -- dispatcher --------------------------------------------------------
 

@@ -330,6 +330,7 @@ class AuthServiceImpl:
             [request.challenge_ids[i] for i in staged])
         users = await self.state.get_users(
             [request.user_ids[i] for i in staged])
+        live: list[tuple[int, UserData]] = []
         for i, challenge, user in zip(staged, challenges, users):
             if (
                 challenge is None
@@ -338,10 +339,20 @@ class AuthServiceImpl:
             ):
                 error_msgs[i] = "Authentication failed"
                 continue
-            try:
-                proof = Proof.from_bytes(request.proofs[i])
-            except errors.Error as e:
-                error_msgs[i] = f"Invalid proof: {e}"
+            live.append((i, user))
+        # Bulk parse: one native validation pass for the whole batch.  On
+        # the inline path the commitment point decodes are deferred to the
+        # batch-verify stage, which decodes them anyway (BatchVerifier
+        # settles failures with the exact parse error); the batcher path
+        # parses eagerly because the shared DynamicBatcher coalesces these
+        # entries with other RPCs' into device batches.
+        parsed = Proof.from_bytes_batch(
+            [request.proofs[i] for i, _ in live],
+            defer_point_validation=self.batcher is None,
+        )
+        for (i, user), proof in zip(live, parsed):
+            if isinstance(proof, errors.Error):
+                error_msgs[i] = f"Invalid proof: {proof}"
                 continue
             try:
                 batch.add_with_context(
@@ -357,32 +368,9 @@ class AuthServiceImpl:
         if len(batch) > 0:
             try:
                 if self.batcher is not None:
-                    import asyncio
-
-                    # return_exceptions so one QueueFull doesn't orphan the
-                    # sibling submits that already enqueued — their results
-                    # are awaited (and discarded) before the RPC aborts
-                    gathered = await asyncio.gather(
-                        *[
-                            self.batcher.submit(
-                                e.params, e.statement, e.proof, e.transcript_context
-                            )
-                            for e in batch.entries
-                        ],
-                        return_exceptions=True,
-                    )
-                    for r in gathered:
-                        if isinstance(r, BaseException) and not isinstance(
-                            r, (batching.QueueFull, errors.Error)
-                        ):
-                            raise r
-                    if any(isinstance(r, batching.QueueFull) for r in gathered):
-                        raise batching.QueueFull("verification queue at capacity")
-                    # each element is now None (ok) or an errors.Error
-                    # (returned or raised by submit — same meaning)
-                    batch_results = [
-                        r if isinstance(r, errors.Error) else None for r in gathered
-                    ]
+                    # one bulk enqueue; all-or-nothing on backpressure, so
+                    # no orphaned sibling submits to drain on QueueFull
+                    batch_results = await self.batcher.submit_many(batch.entries)
                 else:
                     batch_results = batch.verify(self.rng)
             except batching.QueueFull:
@@ -394,7 +382,8 @@ class AuthServiceImpl:
                 metrics.counter("auth.verify_batch.failure").inc()
                 await context.abort(grpc.StatusCode.INTERNAL, f"Batch verification failed: {e}")
 
-        # session issuance for verified items — one bulk mint (single lock)
+        # session issuance for verified items — one bulk mint (single lock,
+        # single CSPRNG draw sliced into per-item tokens)
         verified: list[int] = []
         tokens: dict[int, str] = {}
         batch_index = 0
@@ -406,43 +395,50 @@ class AuthServiceImpl:
             batch_index += 1
             if verify_errs[i] is None:
                 verified.append(i)
-                tokens[i] = self.rng.fill_bytes(32).hex()
+        token_pool = self.rng.fill_bytes(32 * len(verified)).hex()
+        for k, i in enumerate(verified):
+            tokens[i] = token_pool[64 * k: 64 * (k + 1)]
         session_errs = await self.state.create_sessions(
             [(tokens[i], contexts[i]) for i in verified])
         session_err_by_index = dict(zip(verified, session_errs))
 
         results = []
+        n_failure = 0
+        Result = self.pb2.VerificationResult
         for i in range(n):
             user_id = contexts[i]
             if user_id is None:
-                results.append(
-                    self.pb2.VerificationResult(success=False, message=error_msgs[i])
-                )
-                metrics.counter("auth.verify_batch.individual_failure").inc()
+                results.append(Result(success=False, message=error_msgs[i]))
+                n_failure += 1
                 continue
-            if verify_errs[i] is not None:
-                results.append(
-                    self.pb2.VerificationResult(success=False, message="Authentication failed")
-                )
-                metrics.counter("auth.verify_batch.individual_failure").inc()
+            verr = verify_errs[i]
+            if verr is not None:
+                # a deferred-parse proof whose commitment wire failed to
+                # decode reports the exact parse-time message; genuine
+                # verification failures stay opaque (service.rs:528)
+                if isinstance(verr, errors.InvalidProofEncoding):
+                    msg = f"Invalid proof: {verr}"
+                else:
+                    msg = "Authentication failed"
+                results.append(Result(success=False, message=msg))
+                n_failure += 1
                 continue
             serr = session_err_by_index[i]
             if serr is not None:
-                results.append(
-                    self.pb2.VerificationResult(
-                        success=False, message=f"Failed to create session: {serr}"
-                    )
-                )
-                metrics.counter("auth.verify_batch.individual_failure").inc()
+                results.append(Result(
+                    success=False, message=f"Failed to create session: {serr}"
+                ))
+                n_failure += 1
                 continue
-            results.append(
-                self.pb2.VerificationResult(
-                    success=True,
-                    message=f"User '{user_id}' authenticated successfully",
-                    session_token=tokens[i],
-                )
-            )
-            metrics.counter("auth.verify_batch.individual_success").inc()
+            results.append(Result(
+                success=True,
+                message=f"User '{user_id}' authenticated successfully",
+                session_token=tokens[i],
+            ))
+        if n_failure:
+            metrics.counter("auth.verify_batch.individual_failure").inc(n_failure)
+        if n - n_failure:
+            metrics.counter("auth.verify_batch.individual_success").inc(n - n_failure)
 
         metrics.histogram("auth.verify_batch.duration").observe(time.perf_counter() - start)
         metrics.counter("auth.verify_batch.success").inc()

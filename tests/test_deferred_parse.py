@@ -1,0 +1,184 @@
+"""Deferred proof parsing: the serving-path fast parse postpones commitment
+point decodes to the batch-verify stage (one decode per point across
+ingress+verify).  These tests pin the invariant that deferral is
+OBSERVATIONALLY IDENTICAL to eager parsing — same accept/reject set, same
+error messages (reference ``gadgets.rs:364-489`` / ``service.rs:407-617``)
+— across the gadget, dispatcher, and gRPC layers.
+"""
+
+import asyncio
+
+from cpzk_tpu import Parameters, Prover, SecureRng, Transcript, Witness
+from cpzk_tpu.client import AuthClient
+from cpzk_tpu.core.ristretto import Ristretto255
+from cpzk_tpu.errors import Error, InvalidProofEncoding
+from cpzk_tpu.protocol.batch import BatchVerifier
+from cpzk_tpu.protocol.gadgets import PROOF_WIRE_SIZE, Proof
+from cpzk_tpu.server import RateLimiter, ServerState
+from cpzk_tpu.server.service import serve
+
+BAD_POINT_MSG = "Bytes do not represent a valid Ristretto point"
+
+
+def _proof_corpus():
+    """One valid wire plus every malformed family the parser rejects."""
+    rng = SecureRng()
+    params = Parameters.new()
+    prover = Prover(params, Witness(Ristretto255.random_scalar(rng)))
+    t = Transcript()
+    t.append_context(b"ctx")
+    wire = prover.prove_with_transcript(rng, t).to_bytes()
+    assert len(wire) == PROOF_WIRE_SIZE
+    l_bytes = (2**252 + 27742317777372353535851937790883648493).to_bytes(32, "little")
+    return wire, [
+        wire,
+        wire[:50],                                # truncated
+        b"",                                      # empty
+        b"\x02" + wire[1:],                       # bad version
+        wire[:5] + bytes(32) + wire[37:],         # identity r1
+        wire[:41] + bytes(32) + wire[73:],        # identity r2
+        wire[:5] + b"\xff" * 32 + wire[37:],      # invalid r1 point
+        wire[:41] + b"\xff" * 32 + wire[73:],     # invalid r2 point
+        wire[:77] + bytes(32),                    # zero scalar
+        wire[:77] + l_bytes,                      # non-canonical scalar (= l)
+        wire + b"\x00",                           # trailing byte
+        wire[:1] + b"\x00\x00\x00\x21" + wire[5:],  # wrong length field
+    ]
+
+
+def _eager_result(item):
+    try:
+        Proof.from_bytes(item)
+        return "OK"
+    except Error as e:
+        return f"{type(e).__name__}: {e}"
+
+
+def test_from_bytes_batch_eager_differential():
+    _, corpus = _proof_corpus()
+    for got, item in zip(Proof.from_bytes_batch(corpus), corpus):
+        want = _eager_result(item)
+        if isinstance(got, Proof):
+            assert want == "OK"
+            assert not got.deferred
+            assert got.to_bytes() == item
+        else:
+            assert f"{type(got).__name__}: {got}" == want
+
+
+def test_from_bytes_batch_deferred_differential():
+    """Deferred mode: only point-decode failures may surface later (as a
+    deferred Proof); every other malformation errors identically here."""
+    _, corpus = _proof_corpus()
+    for got, item in zip(
+        Proof.from_bytes_batch(corpus, defer_point_validation=True), corpus
+    ):
+        want = _eager_result(item)
+        if isinstance(got, Proof):
+            if want != "OK":  # postponed decode failure, settled at verify
+                assert BAD_POINT_MSG in want
+                assert got.deferred
+        else:
+            assert f"{type(got).__name__}: {got}" == want
+
+
+def _entries_for(n):
+    """n independent (params, statement, proof-wire, context) tuples."""
+    rng = SecureRng()
+    params = Parameters.new()
+    out = []
+    for i in range(n):
+        prover = Prover(params, Witness(Ristretto255.random_scalar(rng)))
+        ctx = b"ctx-%d" % i
+        t = Transcript()
+        t.append_context(ctx)
+        wire = prover.prove_with_transcript(rng, t).to_bytes()
+        out.append((params, prover.statement, wire, ctx))
+    return out
+
+
+def test_batch_verifier_settles_deferred_rows():
+    """Multi-row dispatch: valid deferred rows pass, an undecodable
+    commitment wire maps to the exact parse error, a wrong-context row is
+    a plain verification failure — all in one pass."""
+    entries = _entries_for(4)
+    wires = [w for _, _, w, _ in entries]
+    wires[1] = wires[1][:5] + b"\xff" * 32 + wires[1][37:]  # bad r1 point
+    parsed = Proof.from_bytes_batch(wires, defer_point_validation=True)
+    assert all(isinstance(p, Proof) and p.deferred for p in parsed)
+
+    bv = BatchVerifier()
+    for (params, stmt, _, ctx), proof in zip(entries, parsed):
+        use_ctx = b"wrong" if ctx == b"ctx-3" else ctx
+        bv.add_with_context(params, stmt, proof, use_ctx)
+    results = bv.verify(SecureRng())
+    assert results[0] is None and results[2] is None
+    assert isinstance(results[1], InvalidProofEncoding)
+    assert str(results[1]) == BAD_POINT_MSG
+    assert results[3] is not None and not isinstance(results[3], InvalidProofEncoding)
+
+
+def test_batch_verifier_single_deferred_row():
+    """n == 1 screens eagerly: a bad wire errors with parse parity, a good
+    one verifies through the individual path."""
+    (params, stmt, wire, ctx), = _entries_for(1)
+
+    good, = Proof.from_bytes_batch([wire], defer_point_validation=True)
+    bv = BatchVerifier()
+    bv.add_with_context(params, stmt, good, ctx)
+    assert bv.verify(SecureRng()) == [None]
+
+    bad_wire = wire[:41] + b"\xff" * 32 + wire[73:]
+    bad, = Proof.from_bytes_batch([bad_wire], defer_point_validation=True)
+    if isinstance(bad, Proof):  # native frame path present -> deferred
+        bv = BatchVerifier()
+        bv.add_with_context(params, stmt, bad, ctx)
+        res, = bv.verify(SecureRng())
+        assert isinstance(res, InvalidProofEncoding) and str(res) == BAD_POINT_MSG
+
+
+def test_grpc_batch_reports_exact_parse_error_for_bad_point():
+    """End to end: the inline serving path defers parsing, yet a bad-point
+    item still reports the eager parse message and consumes its challenge;
+    valid siblings authenticate."""
+
+    async def flow():
+        state = ServerState()
+        server, port = await serve(
+            state, RateLimiter(10_000, 10_000), host="127.0.0.1", port=0
+        )
+        try:
+            rng = SecureRng()
+            async with AuthClient(f"127.0.0.1:{port}") as client:
+                users = []
+                for i in range(3):
+                    prover = Prover(
+                        Parameters.new(), Witness(Ristretto255.random_scalar(rng))
+                    )
+                    resp = await client.register(
+                        f"dp{i}",
+                        Ristretto255.element_to_bytes(prover.statement.y1),
+                        Ristretto255.element_to_bytes(prover.statement.y2),
+                    )
+                    assert resp.success
+                    users.append((f"dp{i}", prover))
+
+                ids, cids, proofs = [], [], []
+                for user_id, prover in users:
+                    ch = await client.create_challenge(user_id)
+                    cid = bytes(ch.challenge_id)
+                    t = Transcript()
+                    t.append_context(cid)
+                    proofs.append(prover.prove_with_transcript(rng, t).to_bytes())
+                    ids.append(user_id)
+                    cids.append(cid)
+                proofs[1] = proofs[1][:5] + b"\xff" * 32 + proofs[1][37:]
+
+                resp = await client.verify_proof_batch(ids, cids, proofs)
+                assert [r.success for r in resp.results] == [True, False, True]
+                assert resp.results[1].message == f"Invalid proof: {BAD_POINT_MSG}"
+                assert await state.challenge_count() == 0  # all consumed
+        finally:
+            await server.stop(None)
+
+    asyncio.run(flow())

@@ -31,13 +31,16 @@ from cpzk_tpu.parallel import multihost
 
 multihost.initialize()  # CPZK_COORDINATOR / _NUM_PROCESSES / _PROCESS_ID env
 
+EXPECT_PC = int(os.environ["CPZK_TEST_EXPECT_PROCS"])
+EXPECT_LOCAL = int(os.environ["CPZK_TEST_EXPECT_LOCAL"])
+
 pi, pc = multihost.process_info()
-assert pc == 2, f"expected 2 processes, got {pc}"
-assert jax.device_count() == 4, jax.device_count()
-assert len(jax.local_devices()) == 2
+assert pc == EXPECT_PC, f"expected {EXPECT_PC} processes, got {pc}"
+assert jax.device_count() == EXPECT_PC * EXPECT_LOCAL, jax.device_count()
+assert len(jax.local_devices()) == EXPECT_LOCAL
 
 mesh = multihost.global_batch_mesh()
-assert mesh.devices.size == 4
+assert mesh.devices.size == EXPECT_PC * EXPECT_LOCAL
 
 # Deterministic corpus: every process must build identical host data (SPMD
 # over identical replicated inputs).  A counter-stream "rng" replaces the
@@ -70,9 +73,18 @@ for i in range(6):
     proof = pr.prove_with_transcript(rng, Transcript())
     rows.append((pr.statement, proof))
 
-backend = TpuBackend(mesh_devices=0)  # global mesh: all 4 devices
-assert backend._mesh is not None and backend._mesh.devices.size == 4
+backend = TpuBackend(mesh_devices=0)  # global mesh: all devices
+assert backend._mesh is not None
+assert backend._mesh.devices.size == EXPECT_PC * EXPECT_LOCAL
 
+# all-valid batch: the combined RLC single-check path must accept it
+# across the cross-process mesh (TpuBackend.prefers_combined)
+bv = BatchVerifier(backend=backend)
+for st, p in rows:
+    bv.add(params, st, p)
+assert bv.verify(rng) == [None] * 6
+
+# mismatched row -> combined check fails -> per-row fallback isolates it
 bv = BatchVerifier(backend=backend)
 for st, p in rows:
     bv.add(params, st, p)
@@ -85,6 +97,49 @@ print(f"MULTIHOST_OK process={pi}/{pc} devices={jax.device_count()}")
 """
 
 
+def test_single_process_global_mesh_serves_backend_and_prover():
+    """Default-suite multihost coverage (VERDICT r4 item 5): the same
+    entrypoints a pod deployment uses — ``multihost.initialize`` (no-op
+    single-process), ``global_batch_mesh`` — feed a TpuBackend verify and
+    a BatchProver statement pass over the full 8-virtual-device mesh, so
+    the multihost module is exercised beyond import without the slow
+    2-process gate."""
+    from cpzk_tpu import Parameters, Prover, SecureRng, Transcript, Witness
+    from cpzk_tpu.core.ristretto import Ristretto255
+    from cpzk_tpu.ops.backend import TpuBackend
+    from cpzk_tpu.ops.prove import BatchProver
+    from cpzk_tpu.parallel import multihost
+    from cpzk_tpu.protocol.batch import BatchVerifier
+
+    multihost.initialize()  # unconfigured: must be a no-op, not a latch
+    pi, pc = multihost.process_info()
+    assert (pi, pc) == (0, 1)
+    mesh = multihost.global_batch_mesh()
+    import jax
+
+    assert mesh.devices.size == jax.device_count() >= 1
+
+    rng = SecureRng()
+    params = Parameters.new()
+    bv = BatchVerifier(backend=TpuBackend(mesh_devices=0))
+    witnesses = [Ristretto255.random_scalar(rng) for _ in range(3)]
+    for w in witnesses:
+        prover = Prover(params, Witness(w))
+        t = Transcript()
+        t.append_context(b"mh")
+        proof = prover.prove_with_transcript(rng, t)
+        bv.add_with_context(params, prover.statement, proof, b"mh")
+    assert bv.verify(rng) == [None] * 3
+
+    # prover side over the same global mesh: device statements must match
+    # the host-plane derivation bit-exactly
+    bp = BatchProver(params, mesh_devices=0)
+    for (y1b, y2b), w in zip(bp.statements(witnesses), witnesses):
+        g, h = params.generator_g, params.generator_h
+        assert y1b == Ristretto255.element_to_bytes(Ristretto255.scalar_mul(g, w))
+        assert y2b == Ristretto255.element_to_bytes(Ristretto255.scalar_mul(h, w))
+
+
 def _free_port() -> int:
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
@@ -94,10 +149,17 @@ def _free_port() -> int:
 @pytest.mark.slow
 @pytest.mark.skipif(
     not os.environ.get("CPZK_SLOW_TESTS"),
-    reason="set CPZK_SLOW_TESTS=1 (CI slow tier) — spawns a 2-process "
-    "coordinator-backed job, ~2 min",
+    reason="set CPZK_SLOW_TESTS=1 (CI slow tier) — spawns a coordinator-"
+    "backed multi-process job, ~2 min each",
 )
-def test_two_process_distributed_sharded_verify():
+@pytest.mark.parametrize(
+    "n_procs,local_devices",
+    [
+        (2, 2),  # two hosts x two chips: the v5e-slice topology class
+        (4, 1),  # four hosts x one chip: max process fan-out on DCN
+    ],
+)
+def test_multi_process_distributed_sharded_verify(n_procs, local_devices):
     port = _free_port()
     env_base = dict(os.environ)
     env_base.pop("JAX_PLATFORMS", None)
@@ -105,13 +167,17 @@ def test_two_process_distributed_sharded_verify():
     # startup, which initializes the XLA backend before
     # jax.distributed.initialize can run; disarm it for the CPU workers
     env_base.pop("PALLAS_AXON_POOL_IPS", None)
-    env_base["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    env_base["XLA_FLAGS"] = (
+        f"--xla_force_host_platform_device_count={local_devices}"
+    )
     env_base["CPZK_COORDINATOR"] = f"127.0.0.1:{port}"
-    env_base["CPZK_NUM_PROCESSES"] = "2"
+    env_base["CPZK_NUM_PROCESSES"] = str(n_procs)
+    env_base["CPZK_TEST_EXPECT_PROCS"] = str(n_procs)
+    env_base["CPZK_TEST_EXPECT_LOCAL"] = str(local_devices)
     env_base["CPZK_NO_NATIVE_BUILD"] = "1"  # no concurrent make churn
 
     procs = []
-    for pid in range(2):
+    for pid in range(n_procs):
         env = dict(env_base, CPZK_PROCESS_ID=str(pid))
         procs.append(
             subprocess.Popen(

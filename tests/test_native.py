@@ -161,21 +161,27 @@ def test_native_verify_rows_differential():
         b"".join(Ristretto255.scalar_to_bytes(c) for _, _, c in rows),
     ]
     g, h = eb(params.generator_g), eb(params.generator_h)
-    assert _native.verify_rows(g, h, *cols) == [True] * 6
+    assert _native.verify_rows(g, h, *cols) == [1] * 6
 
     # corrupted challenge -> that row only fails
     bad = cols[5][:32] + bytes(32) + cols[5][64:]
-    assert _native.verify_rows(g, h, *cols[:5], bad) == [True, False] + [True] * 4
+    assert _native.verify_rows(g, h, *cols[:5], bad) == [1, 0] + [1] * 4
 
     # swapped statements -> both swapped rows fail
     y1_sw = cols[0][32:64] + cols[0][:32] + cols[0][64:]
     res = _native.verify_rows(g, h, y1_sw, *cols[1:])
-    assert res[0] is False and res[1] is False and res[2:] == [True] * 4
+    assert res[0] == 0 and res[1] == 0 and res[2:] == [1] * 4
 
-    # invalid point encoding in a row -> clean False, no crash
+    # invalid STATEMENT encoding in a row -> plain failure (0), no crash
     y1_bad = b"\xff" * 32 + cols[0][32:]
     res = _native.verify_rows(g, h, y1_bad, *cols[1:])
-    assert res[0] is False and res[1:] == [True] * 5
+    assert res[0] == 0 and res[1:] == [1] * 5
+
+    # invalid COMMITMENT encoding -> tri-state 2 (deferred-parse contract:
+    # the serving layer maps it back to the exact parse error)
+    r1_bad = b"\xff" * 32 + cols[2][32:]
+    res = _native.verify_rows(g, h, cols[0], cols[1], r1_bad, *cols[3:])
+    assert res[0] == 2 and res[1:] == [1] * 5
 
 
 def test_native_point_validate_differential():
