@@ -89,8 +89,14 @@ class Element:
     def point(self) -> edwards.Point:
         if self._point is None:
             self._point = edwards.ristretto_decode(self._wire)
-            if self._point is None:  # native core produced it; cannot happen
-                raise InvalidGroupElement("Corrupt cached encoding")
+            if self._point is None:
+                # Adversarially reachable: a deferred-parse proof's
+                # commitment wire (frame-checked, point decode postponed)
+                # can be undecodable — CpuBackend.verify_each catches this
+                # and maps it to row status 2.  For internally-produced
+                # wires it remains impossible.
+                raise InvalidGroupElement(
+                    "Bytes do not represent a valid Ristretto point")
         return self._point
 
     def wire(self) -> bytes:

@@ -153,10 +153,12 @@ class CpuBackend(VerifierBackend):
         return out
 
     @staticmethod
-    def _verify_each_native(rows: list[BatchRow]) -> list[bool] | None:
+    def _verify_each_native(rows: list[BatchRow]) -> list[int] | None:
         """Threaded C++ row verification (native/ristretto.cpp) when the
         library is loadable and the batch shares one generator pair; None
-        routes the caller to the pure-Python oracle."""
+        routes the caller to the pure-Python oracle.  Statuses per the
+        ``verify_each`` contract: 1 pass, 0 fail, 2 commitment-decode
+        failure (NOT truthy-pass — deferred rows only)."""
         if not rows:
             return []
         if not all(r.g == rows[0].g and r.h == rows[0].h for r in rows):
@@ -236,7 +238,7 @@ class FailoverBackend(VerifierBackend):
             return self.fallback.verify_combined(rows, beta)
         return False
 
-    def verify_each(self, rows: list[BatchRow]) -> list[bool]:
+    def verify_each(self, rows: list[BatchRow]) -> list[int]:
         if not self.degraded:
             try:
                 return self.primary.verify_each(rows)

@@ -7,7 +7,7 @@ validates the statement first and mirrors the prover's Fiat-Shamir ordering
 
 from __future__ import annotations
 
-from ..errors import InvalidParams
+from ..errors import InvalidParams, InvalidProofEncoding
 from ..core.ristretto import Ristretto255, Scalar
 from ..core.transcript import Transcript
 from .gadgets import Parameters, Proof, Statement
@@ -67,7 +67,13 @@ class Verifier:
             threads=1,
         )
         if native is not None:
-            if native[0] != 1:  # 0 = fail, 2 = commitment decode failure
+            if native[0] == 2:
+                # a deferred-parse proof whose commitment wire never
+                # decoded: keep eager-parse error parity even at this
+                # single-proof entry point
+                raise InvalidProofEncoding(
+                    "Bytes do not represent a valid Ristretto point")
+            if native[0] != 1:
                 raise InvalidParams("Proof verification failed")
             return
 

@@ -307,16 +307,21 @@ class AuthServiceImpl:
             )
         metrics.counter("auth.verify_batch.proofs_count").inc(n)
 
+        # materialize the repeated fields once: protobuf repeated-field
+        # __getitem__ costs add up over 3 accesses x 1000 items
+        user_ids = list(request.user_ids)
+        challenge_ids = list(request.challenge_ids)
+        proof_wires = list(request.proofs)
+
         batch = BatchVerifier(backend=self.backend)
         contexts: list[str | None] = []  # user_id once queued for verify, else None
         error_msgs: list[str] = []
         # stage 1: argument validation (no awaits)
         staged: list[int] = []  # indices that passed arg validation
         for i in range(n):
-            msg = _user_id_error(request.user_ids[i])
+            msg = _user_id_error(user_ids[i])
             if msg is None:
-                msg = _proof_args_error(
-                    request.challenge_ids[i], request.proofs[i], index=i)
+                msg = _proof_args_error(challenge_ids[i], proof_wires[i], index=i)
             contexts.append(None)
             error_msgs.append(msg or "")
             if msg is None:
@@ -327,14 +332,14 @@ class AuthServiceImpl:
         # one lock acquisition for all n consumes (and one for the user
         # lookups) instead of 2n event-loop round-trips.
         challenges = await self.state.consume_challenges(
-            [request.challenge_ids[i] for i in staged])
+            [challenge_ids[i] for i in staged])
         users = await self.state.get_users(
-            [request.user_ids[i] for i in staged])
+            [user_ids[i] for i in staged])
         live: list[tuple[int, UserData]] = []
         for i, challenge, user in zip(staged, challenges, users):
             if (
                 challenge is None
-                or challenge.user_id != request.user_ids[i]
+                or challenge.user_id != user_ids[i]
                 or user is None
             ):
                 error_msgs[i] = "Authentication failed"
@@ -347,22 +352,22 @@ class AuthServiceImpl:
         # parses eagerly because the shared DynamicBatcher coalesces these
         # entries with other RPCs' into device batches.
         parsed = Proof.from_bytes_batch(
-            [request.proofs[i] for i, _ in live],
+            [proof_wires[i] for i, _ in live],
             defer_point_validation=self.batcher is None,
         )
+        params = Parameters.new()  # shared generators: one instance per RPC
         for (i, user), proof in zip(live, parsed):
             if isinstance(proof, errors.Error):
                 error_msgs[i] = f"Invalid proof: {proof}"
                 continue
             try:
                 batch.add_with_context(
-                    Parameters.new(), user.statement, proof,
-                    bytes(request.challenge_ids[i]),
+                    params, user.statement, proof, bytes(challenge_ids[i]),
                 )
             except errors.Error as e:
                 error_msgs[i] = f"Failed to add proof to batch: {e}"
                 continue
-            contexts[i] = request.user_ids[i]
+            contexts[i] = user_ids[i]
 
         batch_results: list = []
         if len(batch) > 0:

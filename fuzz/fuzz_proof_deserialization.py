@@ -34,7 +34,41 @@ def _seeds() -> list[bytes]:
 def one_input(data: bytes) -> None:
     try:
         proof = Proof.from_bytes(data)
-    except Error:
+        verdict = "OK"
+    except Error as e:
+        proof = None
+        verdict = f"{type(e).__name__}: {e}"
+
+    # three-way parse differential: the batched native pass and the
+    # deferred-parse pipeline (frame check now, point decodes settled by
+    # the dispatcher's screening) must agree with the eager parser on
+    # accept/reject AND on the exact error
+    b_eager, = Proof.from_bytes_batch([data])
+    b_defer, = Proof.from_bytes_batch([data], defer_point_validation=True)
+    if isinstance(b_eager, Proof):
+        assert verdict == "OK", f"batch accepted what eager rejected: {verdict}"
+    else:
+        assert verdict == f"{type(b_eager).__name__}: {b_eager}", (
+            verdict, f"{type(b_eager).__name__}: {b_eager}")
+    if isinstance(b_defer, Proof):
+        if b_defer.deferred:  # settle the postponed decodes like verify does
+            from cpzk_tpu.protocol.batch import BatchEntry, BatchVerifier
+            from cpzk_tpu.protocol.gadgets import Parameters
+
+            bv = BatchVerifier()
+            bv.entries.append(BatchEntry(Parameters.new(), None, b_defer, None))
+            errs = bv._screen_deferred()
+            if verdict == "OK":
+                assert not errs, f"screening rejected an eager-valid wire: {errs}"
+            else:
+                assert 0 in errs, f"deferred pipeline accepted: {verdict}"
+        else:
+            assert verdict == "OK"
+    else:
+        assert verdict == f"{type(b_defer).__name__}: {b_defer}", (
+            verdict, f"{type(b_defer).__name__}: {b_defer}")
+
+    if proof is None:
         return  # expected rejection path
     # canonical wire format: parse -> serialize must be the identity
     assert proof.to_bytes() == bytes(data), "non-canonical accept"
