@@ -8,14 +8,20 @@ verification, per-item session issuance), against the reference analog
 `src/verifier/service.rs:407-617`.
 
 Prints one JSON line per curve point:
-    {"metric": "e2e_curve", "n": N, "grpc_pps": ..., "direct_pps": ...,
-     "platform": ..., "backend": ..., "unit": "proofs/s"}
+    {"metric": "e2e_curve", "n": N, "grpc_pps": ...,
+     "grpc_pipelined_pps": ..., "direct_pps": ..., "platform": ...,
+     "backend": ..., "unit": "proofs/s"}
 
 - grpc_pps  — proofs/s through the real asyncio gRPC loopback service
-              (batched RPCs of <=1000 items, reference cap parity).
+              (batched RPCs of <=1000 items, reference cap parity),
+              one RPC in flight at a time.
+- grpc_pipelined_pps — same, but a wave's RPCs issued concurrently: the
+              server verifies on a worker thread (GIL released), so one
+              RPC's Python overlaps another's crypto — the many-client
+              deployment shape.
 - direct_pps — proofs/s through BatchVerifier.verify alone on the same
-              backend (no RPC/session overhead); the gap is the serving
-              layer's cost.
+              backend (no RPC/session overhead); the serial gap is the
+              serving layer's cost.
 
 Backends: --backend cpu (native host core; the production CPU serving
 config) or tpu (device data plane; meaningful on real TPU — on the XLA
@@ -39,6 +45,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 USERS = 512            # corpus users registered once
 CHALLENGES_PER_WAVE = 3  # per-user outstanding-challenge cap (state parity)
 RPC_CAP = 1000         # MAX_BATCH parity (service.rs:428-432)
+PIPELINE_WAYS = 4      # concurrent RPCs per wave in the pipelined pass
 
 
 def build_corpus():
@@ -54,8 +61,12 @@ def build_corpus():
     return rng, params, provers
 
 
-async def grpc_curve_point(n: int, provers, rng, backend_name: str) -> float:
-    """Total wall time of the timed verify RPCs for n proofs -> proofs/s."""
+async def grpc_curve_point(
+    n: int, provers, rng, backend_name: str
+) -> tuple[float, float]:
+    """(serial_pps, pipelined_pps): wall time of the timed verify RPCs for
+    n proofs with one RPC in flight, then with each wave's RPCs issued
+    concurrently (~PIPELINE_WAYS at a time)."""
     import grpc  # noqa: F401  (import check before server spin-up)
 
     from cpzk_tpu import Transcript
@@ -129,11 +140,39 @@ async def grpc_curve_point(n: int, provers, rng, backend_name: str) -> float:
                 # per-user session cap is 5, and each success mints one
                 for s in list(state._sessions):
                     await state.revoke_session(s)
+
+            # pipelined pass: each wave's RPCs in flight CONCURRENTLY, in
+            # ~PIPELINE_WAYS chunks regardless of wave size (a single
+            # RPC_CAP chunk would degenerate to the serial path).  The
+            # server runs the crypto on a worker thread (GIL released), so
+            # RPC k+1's Python overlaps RPC k's verify — the deployment
+            # shape with many clients, and the fairer analog of the
+            # reference's per-request tokio tasks (service.rs:321-405).
+            done = 0
+            timed_p = 0.0
+            while done < n:
+                wave = min(n - done, USERS * CHALLENGES_PER_WAVE)
+                ids, cids, proofs = await make_wave(wave)
+                step = min(RPC_CAP, max(1, -(-wave // PIPELINE_WAYS)))
+                chunks = [(lo, min(lo + step, wave))
+                          for lo in range(0, wave, step)]
+                t0 = time.perf_counter()
+                resps = await asyncio.gather(*[
+                    client.verify_proof_batch(
+                        ids[lo:hi], cids[lo:hi], proofs[lo:hi])
+                    for lo, hi in chunks
+                ])
+                timed_p += time.perf_counter() - t0
+                for resp in resps:
+                    assert all(r.success for r in resp.results), "verify failed"
+                done += wave
+                for s in list(state._sessions):
+                    await state.revoke_session(s)
     finally:
         if batcher is not None:
             await batcher.stop()
         await server.stop(None)
-    return n / timed
+    return n / timed, n / timed_p
 
 
 def direct_curve_point(n: int, provers, rng, params, backend_name: str) -> float:
@@ -193,11 +232,13 @@ def main() -> None:
     rng, params, provers = build_corpus()
     for n in ns:
         direct = direct_curve_point(n, provers, rng, params, args.backend)
-        grpc_pps = asyncio.run(grpc_curve_point(n, provers, rng, args.backend))
+        grpc_pps, grpc_pipelined = asyncio.run(
+            grpc_curve_point(n, provers, rng, args.backend))
         print(json.dumps({
             "metric": "e2e_curve",
             "n": n,
             "grpc_pps": round(grpc_pps, 1),
+            "grpc_pipelined_pps": round(grpc_pipelined, 1),
             "direct_pps": round(direct, 1),
             "platform": platform,
             "backend": args.backend,

@@ -11,6 +11,7 @@ handler API because the protoc gRPC plugin is unavailable (see proto.py).
 
 from __future__ import annotations
 
+import asyncio
 import time
 
 import grpc
@@ -50,6 +51,11 @@ class AuthServiceImpl:
         self.batcher = batcher  # DynamicBatcher | None (TPU serving path)
         self.pb2 = load_pb2()
         self.rng = SecureRng()
+        # inline-verify concurrency: 2 lets one RPC's Python overlap
+        # another's GIL-released crypto without unbounded to_thread
+        # workers each spawning a cpu-wide native pool (crypto-vs-crypto
+        # oversubscription under many concurrent batch RPCs)
+        self._inline_verify = asyncio.Semaphore(2)
 
     # --- helpers ---
 
@@ -377,7 +383,14 @@ class AuthServiceImpl:
                     # no orphaned sibling submits to drain on QueueFull
                     batch_results = await self.batcher.submit_many(batch.entries)
                 else:
-                    batch_results = batch.verify(self.rng)
+                    # worker thread, not the event loop: the native verify
+                    # releases the GIL, so a concurrent RPC's Python
+                    # (parse, state ops, response build) overlaps this
+                    # batch's crypto instead of queueing behind ~100ms of
+                    # blocked loop — and health checks stay responsive
+                    async with self._inline_verify:
+                        batch_results = await asyncio.to_thread(
+                            batch.verify, self.rng)
             except batching.QueueFull:
                 metrics.counter("auth.verify_batch.failure").inc()
                 await context.abort(
