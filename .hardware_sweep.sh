@@ -1,0 +1,76 @@
+#!/bin/sh
+# Tunnel heal-watcher (round 5).  Probes the axon TPU every ~3 min; on
+# heal, runs the full measurement sequence with the crash-hardened
+# bench.py (kernel lines survive child failures).  Artifacts land in
+# .hw/ under benches/calibrate.py's expected names; timeline in
+# .hw/sweep.log.  A lockfile stops it from contending with an
+# interactive TPU session: `touch .hw/LOCK` pauses the watcher.
+cd "$(dirname "$0")" || exit 1
+mkdir -p .hw
+log() { echo "$(date -u +%H:%M:%S) $*" >> .hw/sweep.log; }
+probe() {
+  timeout 90 python -c \
+    "import jax, jax.numpy as jnp; (jnp.zeros((8,))+1).block_until_ready()" \
+    >/dev/null 2>&1
+}
+log "watcher start (pid $$)"
+while :; do
+  if [ -e .hw/LOCK ]; then log "paused (LOCK)"; sleep 180; continue; fi
+  if [ -e .hw/SWEEP_DONE ]; then log "sweep complete; watcher exiting"; exit 0; fi
+  if probe; then
+    log "tunnel ALIVE - starting sweep"
+    # 1. headline bench at 16k (+ e2e artifact)
+    [ -s .hw/bench_16k.json ] && grep -q '"plane": "tpu"' .hw/bench_16k.json || {
+      CPZK_BENCH_N=16384 CPZK_BENCH_E2E=1 CPZK_BENCH_ITERS=3 \
+      CPZK_BENCH_DEADLINE_SECS=1700 CPZK_BENCH_GUARD_SECS=800 \
+        timeout 1800 python bench.py > .hw/bench_16k.json 2>> .hw/sweep.log
+      log "bench_16k: $(cat .hw/bench_16k.json)"; }
+    probe || { log "wedged after bench_16k"; continue; }
+    # 2. 64k tier
+    [ -s .hw/bench_64k.json ] && grep -q '"plane": "tpu"' .hw/bench_64k.json || {
+      CPZK_BENCH_N=65536 CPZK_BENCH_ITERS=3 \
+      CPZK_BENCH_DEADLINE_SECS=2300 CPZK_BENCH_GUARD_SECS=1100 \
+        timeout 2400 python bench.py > .hw/bench_64k.json 2>> .hw/sweep.log
+      log "bench_64k: $(cat .hw/bench_64k.json)"; }
+    probe || { log "wedged after bench_64k"; continue; }
+    # 3. kernel A/Bs at 64k (mul/point/challenge)
+    [ -s .hw/r5_kernels_64k.jsonl ] || {
+      timeout 2400 python benches/bench_kernels.py --n 65536 --iters 3 \
+        --only mul > .hw/k64_mul.jsonl 2>> .hw/sweep.log
+      timeout 2400 python benches/bench_kernels.py --n 65536 --iters 3 \
+        --only point > .hw/k64_point.jsonl 2>> .hw/sweep.log
+      timeout 1200 python benches/bench_kernels.py --n 65536 --iters 3 \
+        --only challenge > .hw/k64_challenge.jsonl 2>> .hw/sweep.log
+      cat .hw/k64_*.jsonl > .hw/r5_kernels_64k.jsonl
+      log "kernels_64k done"; }
+    probe || { log "wedged after kernels_64k"; continue; }
+    # 4. pallas point A/B
+    [ -s .hw/point_pallas.json ] || {
+      CPZK_PALLAS=1 timeout 1800 python benches/bench_kernels.py --n 16384 \
+        --iters 3 --only point > .hw/point_pallas.json 2>> .hw/sweep.log
+      log "point_pallas: $(cat .hw/point_pallas.json)"; }
+    probe || { log "wedged after pallas"; continue; }
+    # 5. window sweep at 16k, pippenger
+    for w in 12 13 14 15 11; do
+      [ -s .hw/win_$w.json ] && grep -q '"plane": "tpu"' .hw/win_$w.json && continue
+      CPZK_BENCH_N=16384 CPZK_BENCH_KERNEL=pippenger CPZK_BENCH_ITERS=3 \
+      CPZK_MSM_WINDOW=$w CPZK_BENCH_DEADLINE_SECS=0 \
+        timeout 1500 python bench.py > .hw/win_$w.json 2>> .hw/sweep.log
+      log "win_$w: $(cat .hw/win_$w.json)"
+      probe || break
+    done
+    probe || { log "wedged during window sweep"; continue; }
+    # 6. crossover point at 1k
+    [ -s .hw/cross_1024.json ] || {
+      timeout 1500 python benches/bench_kernels.py --n 1024 --verify-n 1024 \
+        --iters 3 --only verify > .hw/cross_1024.json 2>> .hw/sweep.log
+      log "cross_1024 done"; }
+    if [ -s .hw/bench_16k.json ] && [ -s .hw/bench_64k.json ] \
+       && [ -s .hw/r5_kernels_64k.jsonl ] && [ -s .hw/win_13.json ]; then
+      touch .hw/SWEEP_DONE; log "ALL measurements landed; exiting"; exit 0
+    fi
+  else
+    log "wedged"
+  fi
+  sleep 150
+done

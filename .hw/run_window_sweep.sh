@@ -1,0 +1,14 @@
+#!/bin/sh
+# CPZK_MSM_WINDOW calibration sweep at N=16384, pippenger kernel only
+# (model picks c=13 at m=4*16384+2; bracket it).  One bench.py run per
+# window; persistent compile cache means each (shape, window) compiles
+# once ever.  Usage: sh .hw/run_window_sweep.sh [windows...]
+set -x
+cd "$(dirname "$0")/.."
+for c in "${@:-11 12 13 14 15}"; do
+  for w in $c; do
+    CPZK_BENCH_N=16384 CPZK_BENCH_KERNEL=pippenger CPZK_BENCH_ITERS=3 \
+      CPZK_MSM_WINDOW=$w timeout 1800 python bench.py \
+      > .hw/win_$w.json 2> .hw/win_$w.err
+  done
+done
