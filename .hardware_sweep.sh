@@ -18,6 +18,7 @@ probe() {
 has_tpu_bench() { grep -q '"plane": "tpu"' "$1" 2>/dev/null; }
 # a real measurement: the metric line exists AND is not an error record
 has_metric() { grep "$2" "$1" 2>/dev/null | grep -qv '"error"'; }
+has_trace() { find .hw/xprof -name '*.xplane.pb' 2>/dev/null | grep -q .; }
 all_done() {
   has_tpu_bench .hw/bench_16k.json && has_tpu_bench .hw/bench_64k.json \
     && has_metric .hw/k64_mul.jsonl field_mul_schoolbook \
@@ -25,7 +26,8 @@ all_done() {
     && has_metric .hw/k64_challenge.jsonl challenge_device \
     && has_metric .hw/point_pallas.json point_add \
     && has_tpu_bench .hw/win_13.json \
-    && has_metric .hw/cross_1024.json verify_
+    && has_metric .hw/cross_1024.json verify_ \
+    && has_trace
 }
 log "watcher start (pid $$)"
 while :; do
@@ -91,6 +93,15 @@ while :; do
       timeout 1500 python benches/bench_kernels.py --n 1024 --verify-n 1024 \
         --iters 3 --only verify > .hw/cross_1024.json 2>> .hw/sweep.log
       log "cross_1024: $(grep verify_ .hw/cross_1024.json | tr '\n' ' ')"; }
+    probe || { log "wedged before xprof"; continue; }
+    # 7. one xprof trace of the winning kernel (steady-state, no compile);
+    # retried until a real .xplane.pb lands (a killed run leaves only the
+    # directory skeleton)
+    has_trace || {
+      rm -rf .hw/xprof
+      timeout 1200 python benches/capture_xprof.py --n 4096 \
+        --kernel rowcombined --outdir .hw/xprof >> .hw/sweep.log 2>&1
+      if has_trace; then log "xprof captured"; else log "xprof FAILED"; fi; }
   else
     log "wedged"
   fi

@@ -169,7 +169,10 @@ def _time_kernel(fn, args) -> float:
     return N / best
 
 
-def bench_pippenger(inp: _Inputs) -> float:
+def _pippenger_setup(inp: _Inputs):
+    """Build device inputs + jitted kernel -> (fn, args); shared by the
+    timed bench and the xprof capture (which must set up OUTSIDE its
+    trace window)."""
     import jax
     import numpy as np
     import jax.numpy as jnp
@@ -199,10 +202,20 @@ def bench_pippenger(inp: _Inputs) -> float:
     )
     dig = jnp.asarray(digits)
     kernel = jax.jit(msm.msm_is_identity_kernel, static_argnums=2)
-    return _time_kernel(lambda p, d: kernel(p, d, c), (pts, dig))
+    return (lambda p, d: kernel(p, d, c)), (pts, dig)
+
+
+def bench_pippenger(inp: _Inputs) -> float:
+    fn, args = _pippenger_setup(inp)
+    return _time_kernel(fn, args)
 
 
 def bench_rowcombined(inp: _Inputs) -> float:
+    fn, args = _rowcombined_setup(inp)
+    return _time_kernel(fn, args)
+
+
+def _rowcombined_setup(inp: _Inputs):
     import jax
     import numpy as np
     import jax.numpy as jnp
@@ -244,7 +257,7 @@ def bench_rowcombined(inp: _Inputs) -> float:
     w_bac = jnp.asarray(scalars_to_windows(inp.bac + [0, 0]))
 
     kernel = jax.jit(verify.combined_kernel)
-    return _time_kernel(kernel, (r1, y1, r2, y2, w_a, w_ac, w_ba, w_bac))
+    return kernel, (r1, y1, r2, y2, w_a, w_ac, w_ba, w_bac)
 
 
 def _emit(value: float, diagnostic: str | None = None,
