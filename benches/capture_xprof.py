@@ -33,6 +33,18 @@ def main() -> None:
         import jax
 
         jax.config.update("jax_platforms", args.platform)
+    # same persistent compile cache bench.main() uses: a watcher retry
+    # must not pay the (minutes-long on a tunnel) kernel compile twice
+    try:
+        import jax
+
+        jax.config.update(
+            "jax_compilation_cache_dir",
+            os.path.join(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__))), ".jax_bench_cache"))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 2)
+    except Exception:
+        pass  # older jax without the knob: cache is best-effort
 
     import time
 
