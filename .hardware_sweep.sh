@@ -27,7 +27,8 @@ all_done() {
     && has_metric .hw/point_pallas.json point_add \
     && has_tpu_bench .hw/win_13.json \
     && has_metric .hw/cross_1024.json verify_ \
-    && has_trace
+    && has_trace \
+    && has_metric .hw/e2e_curve_tpu.json '"backend": "tpu"'
 }
 log "watcher start (pid $$)"
 while :; do
@@ -102,6 +103,13 @@ while :; do
       timeout 1200 python benches/capture_xprof.py --n 4096 \
         --kernel rowcombined --outdir .hw/xprof >> .hw/sweep.log 2>&1
       if has_trace; then log "xprof captured"; else log "xprof FAILED"; fi; }
+    probe || { log "wedged before e2e curve"; continue; }
+    # 8. serving curve against the REAL device backend (gRPC -> batcher ->
+    # TPU) — the north-star configuration, never before measured
+    has_metric .hw/e2e_curve_tpu.json '"backend": "tpu"' || {
+      timeout 1800 python benches/bench_e2e_curve.py --ns 4096 \
+        --backend tpu > .hw/e2e_curve_tpu.json 2>> .hw/sweep.log
+      log "e2e_curve_tpu: $(cat .hw/e2e_curve_tpu.json | tr '\n' ' ')"; }
   else
     log "wedged"
   fi
