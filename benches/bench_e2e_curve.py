@@ -218,6 +218,20 @@ def main() -> None:
         import jax
 
         jax.config.update("jax_platforms", plat)
+    if args.backend == "tpu":
+        # share bench.py's persistent compile cache: the first serving
+        # batch's device program must not re-pay a tunnel-window compile
+        # the kernel sweep already performed
+        try:
+            import jax
+
+            jax.config.update(
+                "jax_compilation_cache_dir",
+                os.path.join(os.path.dirname(os.path.dirname(
+                    os.path.abspath(__file__))), ".jax_bench_cache"))
+            jax.config.update("jax_persistent_cache_min_compile_time_secs", 2)
+        except Exception:
+            pass  # older jax without the knob: cache is best-effort
 
     if args.ns:
         ns = [int(x) for x in args.ns.split(",")]
