@@ -1,0 +1,142 @@
+"""Device-side bisection for the Pippenger >=16k anomaly (PROFILE.md §7a).
+
+On TPU the backend's combined check via `_combined_pippenger` rejected an
+all-valid batch at N=16384 (m=65538 terms, model window c=13) and hung at
+N=65536 (m=262146, c=15), while N=4096 (m=16386, c=11) passes with the
+in-kernel assert.  Every CPU-reachable suspect is exonerated (the MSM
+kernel matches the host oracle at every window c in {8,11,12,13,14,15}
+on the XLA CPU backend, the digit recode round-trips, and the backend
+combined check verifies True at N=16384 on CPU).  This script bisects the
+DEVICE failure into its two stages, each reported independently:
+
+  digits — device signed-digit recode (`sclimbs.to_signed_digits`, the
+           exact `backend._signed_digits_jit` entry) vs the host recode
+           (`msm.scalars_to_signed_digits`) on the same scalars;
+  msm    — the Pippenger sort+scan kernel on HOST-computed digits vs a
+           native-host expected point: points are g_i*G with known g_i,
+           so expected = (sum a_i*g_i mod L)*G needs ONE scalar-mul.
+
+Window-vs-size discrimination matrix (each line is one short device run;
+`touch .hw/LOCK` first so the sweep watcher yields the tunnel):
+
+  python benches/debug_pip16k.py --m 65538 --c 13 --stage digits
+  python benches/debug_pip16k.py --m 65538 --c 13 --stage msm
+  python benches/debug_pip16k.py --m 65538 --c 11 --stage msm   # size only
+  python benches/debug_pip16k.py --m 16386 --c 13 --stage msm   # window only
+
+Reference analog of the computation under test: the accumulation loop at
+`src/verifier/batch.rs:271-312` this kernel replaces.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import secrets
+import sys
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from cpzk_tpu.core import _native
+from cpzk_tpu.core import edwards as he
+from cpzk_tpu.core import scalars as hs
+from cpzk_tpu.ops import backend, curve, msm
+from cpzk_tpu.ops import sclimbs as sc
+
+
+def emit(**kw) -> None:
+    print(json.dumps(kw), flush=True)
+
+
+def stage_digits(m: int, c: int) -> bool:
+    vals = [secrets.randbelow(hs.L) for _ in range(m)]
+    t0 = time.monotonic()
+    host = np.asarray(msm.scalars_to_signed_digits(vals, c))
+    limbs = jnp.asarray(sc.ints_to_limbs(vals))
+    dev = np.asarray(jax.device_get(backend._signed_digits_jit(c, limbs)))
+    bad = np.argwhere(dev != host)
+    rec = {
+        "stage": "digits", "m": m, "c": c,
+        "match": bool(bad.size == 0),
+        "mismatch_cells": int(bad.shape[0]),
+        "secs": round(time.monotonic() - t0, 1),
+        "platform": jax.devices()[0].platform,
+    }
+    if bad.size:
+        k, col = (int(v) for v in bad[0])
+        rec["first_bad"] = {
+            "window": k, "col": col, "scalar": hex(vals[col]),
+            "host_digit": int(host[k, col]), "dev_digit": int(dev[k, col]),
+        }
+        # full digit columns for the first few bad scalars: enough to
+        # replay the recode by hand offline
+        cols = sorted({int(v[1]) for v in bad[:64]})[:4]
+        rec["bad_cols"] = {
+            str(col): {"scalar": hex(vals[col]),
+                       "host": [int(x) for x in host[:, col]],
+                       "dev": [int(x) for x in dev[:, col]]}
+            for col in cols
+        }
+    emit(**rec)
+    return bool(bad.size == 0)
+
+
+def stage_msm(m: int, c: int) -> bool:
+    g_wire = he.ristretto_encode(he.BASEPOINT)
+    gs = [secrets.randbelow(hs.L) for _ in range(m)]
+    avals = [secrets.randbelow(hs.L) for _ in range(m)]
+    t0 = time.monotonic()
+    wires = b"".join(
+        _native.scalarmul(g_wire, hs.sc_to_bytes(g)) for g in gs
+    )
+    expected_wire = _native.scalarmul(
+        g_wire, hs.sc_to_bytes(sum(a * g for a, g in zip(avals, gs)) % hs.L)
+    )
+    setup_secs = round(time.monotonic() - t0, 1)
+
+    pts = curve.wires_to_device(wires, m)
+    digits = jnp.asarray(msm.scalars_to_signed_digits(avals, c))
+    t1 = time.monotonic()
+    out = jax.jit(msm.msm_kernel, static_argnums=2)(pts, digits, c)
+    got = curve.points_from_device(jax.device_get(out))[0]
+    device_secs = round(time.monotonic() - t1, 1)
+
+    got_aff = tuple(v % he.P for v in got)
+    exp_pt = he.ristretto_decode(expected_wire)
+    ok = he.pt_eq(got_aff, exp_pt)
+    emit(stage="msm", m=m, c=c, match=bool(ok), setup_secs=setup_secs,
+         device_secs=device_secs, platform=jax.devices()[0].platform,
+         got=he.ristretto_encode(got_aff).hex(),
+         expected=expected_wire.hex())
+    return bool(ok)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--m", type=int, default=65538)
+    ap.add_argument("--c", type=int, default=13)
+    ap.add_argument("--stage", choices=["digits", "msm", "all"], default="all")
+    ap.add_argument("--platform", default=None,
+                    help="force a jax backend (e.g. cpu); needed because "
+                         "the axon sitecustomize pre-imports jax, so "
+                         "JAX_PLATFORMS alone does not reach its config")
+    args = ap.parse_args()
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+    ok = True
+    if args.stage in ("digits", "all"):
+        ok &= stage_digits(args.m, args.c)
+    if args.stage in ("msm", "all"):
+        ok &= stage_msm(args.m, args.c)
+    raise SystemExit(0 if ok else 1)
+
+
+if __name__ == "__main__":
+    main()
