@@ -104,17 +104,85 @@ def stage_msm(m: int, c: int) -> bool:
     pts = curve.wires_to_device(wires, m)
     digits = jnp.asarray(msm.scalars_to_signed_digits(avals, c))
     t1 = time.monotonic()
-    out = jax.jit(msm.msm_kernel, static_argnums=2)(pts, digits, c)
+    fn = jax.jit(msm.msm_kernel, static_argnums=2)
+    out = fn(pts, digits, c)
     got = curve.points_from_device(jax.device_get(out))[0]
     device_secs = round(time.monotonic() - t1, 1)
+    # determinism probe: same inputs through the cached executable —
+    # separates a deterministic codegen bug from flaky memory corruption
+    out2 = fn(pts, digits, c)
+    got2 = curve.points_from_device(jax.device_get(out2))[0]
 
     got_aff = tuple(v % he.P for v in got)
+    got2_aff = tuple(v % he.P for v in got2)
     exp_pt = he.ristretto_decode(expected_wire)
     ok = he.pt_eq(got_aff, exp_pt)
     emit(stage="msm", m=m, c=c, match=bool(ok), setup_secs=setup_secs,
          device_secs=device_secs, platform=jax.devices()[0].platform,
+         deterministic=bool(he.pt_eq(got_aff, got2_aff)),
          got=he.ristretto_encode(got_aff).hex(),
          expected=expected_wire.hex())
+    return bool(ok)
+
+
+def _sample_cols(pt, cols):
+    """Affine host points for the given lane columns of a device Point."""
+    sub = tuple(np.asarray(jax.device_get(c))[:, cols] for c in pt)
+    return [tuple(v % he.P for v in p) for p in curve.points_from_device(sub)]
+
+
+def stage_addlanes(m: int) -> bool:
+    """Elementwise R = P + Q over m lanes; host-verify 64 sampled lanes.
+
+    The deepest isolation: rowcombined (no sort/scan) and the MSM
+    (sort+scan) both fail past ~33k lanes, so the shared suspect is the
+    lane-parallel extended-coordinate add itself under large lane counts.
+    """
+    g_wire = he.ristretto_encode(he.BASEPOINT)
+    gp = [secrets.randbelow(hs.L) for _ in range(m)]
+    gq = [secrets.randbelow(hs.L) for _ in range(m)]
+    t0 = time.monotonic()
+    wp = b"".join(_native.scalarmul(g_wire, hs.sc_to_bytes(g)) for g in gp)
+    wq = b"".join(_native.scalarmul(g_wire, hs.sc_to_bytes(g)) for g in gq)
+    setup_secs = round(time.monotonic() - t0, 1)
+    P = curve.wires_to_device(wp, m)
+    Q = curve.wires_to_device(wq, m)
+    t1 = time.monotonic()
+    R = jax.jit(curve.add)(P, Q)
+    jax.block_until_ready(R)
+    device_secs = round(time.monotonic() - t1, 1)
+    cols = sorted({secrets.randbelow(m) for _ in range(64)})
+    got = _sample_cols(R, cols)
+    bad = []
+    for col, gpt in zip(cols, got):
+        exp_wire = _native.scalarmul(
+            g_wire, hs.sc_to_bytes((gp[col] + gq[col]) % hs.L))
+        if not he.pt_eq(gpt, he.ristretto_decode(exp_wire)):
+            bad.append(col)
+    emit(stage="addlanes", m=m, match=not bad, bad_lanes=bad[:8],
+         sampled=len(cols), setup_secs=setup_secs,
+         device_secs=device_secs, platform=jax.devices()[0].platform)
+    return not bad
+
+
+def stage_sum(m: int) -> bool:
+    """tree_sum of m lanes of known points vs ONE native scalar-mul."""
+    g_wire = he.ristretto_encode(he.BASEPOINT)
+    gp = [secrets.randbelow(hs.L) for _ in range(m)]
+    t0 = time.monotonic()
+    wp = b"".join(_native.scalarmul(g_wire, hs.sc_to_bytes(g)) for g in gp)
+    setup_secs = round(time.monotonic() - t0, 1)
+    P = curve.wires_to_device(wp, m)
+    t1 = time.monotonic()
+    S = jax.jit(lambda p: curve.tree_sum(p, axis=-1))(P)
+    arrs = [np.asarray(jax.device_get(c)) for c in S]
+    arrs = [a[:, None] if a.ndim == 1 else a for a in arrs]
+    got = curve.points_from_device(tuple(arrs))[0]
+    device_secs = round(time.monotonic() - t1, 1)
+    exp_wire = _native.scalarmul(g_wire, hs.sc_to_bytes(sum(gp) % hs.L))
+    ok = he.pt_eq(tuple(v % he.P for v in got), he.ristretto_decode(exp_wire))
+    emit(stage="sum", m=m, match=bool(ok), setup_secs=setup_secs,
+         device_secs=device_secs, platform=jax.devices()[0].platform)
     return bool(ok)
 
 
@@ -122,7 +190,9 @@ def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--m", type=int, default=65538)
     ap.add_argument("--c", type=int, default=13)
-    ap.add_argument("--stage", choices=["digits", "msm", "all"], default="all")
+    ap.add_argument("--stage",
+                    choices=["digits", "msm", "addlanes", "sum", "all"],
+                    default="all")
     ap.add_argument("--platform", default=None,
                     help="force a jax backend (e.g. cpu); needed because "
                          "the axon sitecustomize pre-imports jax, so "
@@ -135,6 +205,13 @@ def main() -> None:
         ok &= stage_digits(args.m, args.c)
     if args.stage in ("msm", "all"):
         ok &= stage_msm(args.m, args.c)
+    if args.stage in ("addlanes", "all"):
+        ok &= stage_addlanes(args.m)
+    if args.stage in ("sum", "all"):
+        # NOTE: hangs >25 min at m=65536 on TPU v5 lite (the large-lane
+        # monolith pathology under investigation) — run last so the
+        # other stages' verdicts land first
+        ok &= stage_sum(args.m)
     raise SystemExit(0 if ok else 1)
 
 

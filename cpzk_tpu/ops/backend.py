@@ -8,10 +8,12 @@ and the windowed-Pippenger MSM in :mod:`cpzk_tpu.ops.msm`.  Batch shapes
 are padded to powers of two so ``jax.jit`` caches a handful of programs
 instead of one per batch size.
 
-The combined RLC check dispatches by size: small batches use the per-row
-shared-doubling kernel (table-build overhead amortizes badly), large ones
-the Pippenger MSM over all 4n+2 terms, whose per-term cost falls with batch
-size (see ``ops/msm.py``).
+The combined RLC check dispatches by topology: single-device batches use
+the per-row shared-doubling kernel at EVERY size (calibrated winner on TPU
+v5 lite — see ``PIPPENGER_MIN_ROWS``), tiled into ``LANE_CHUNK``-lane
+programs past the device's proven program size; mesh-sharded batches route
+through the Pippenger MSM over all 4n+2 terms, whose per-device partial
+points combine over ICI (``parallel/mesh.py``).
 
 Semantics parity (reference ``src/verifier/batch.rs``): the combined check
 is only an accelerator — on failure ``BatchVerifier`` falls back to
@@ -36,10 +38,26 @@ from ..protocol.batch import BatchRow, VerifierBackend
 from . import curve, msm, verify
 
 #: Row count at or above which the combined check uses the Pippenger MSM
-#: instead of per-row windowed chains (crossover from the cost model in
-#: ``msm.pick_window``; below this the per-row kernel's 570 ops/row win).
-#: Env-tunable (CPZK_PIPPENGER_MIN) for on-hardware crossover tuning.
-PIPPENGER_MIN_ROWS = int(os.environ.get("CPZK_PIPPENGER_MIN", "32"))
+#: instead of per-row windowed chains.  Calibrated on TPU v5 lite
+#: (.hw/ sweep, round 5): the per-row kernel wins EVERY measured A/B —
+#: 11,991 vs 7,844 proofs/s at n=1024, 24,714 vs 19,028 at n=4096 — so
+#: the single-device default is "never" (the Pippenger path remains the
+#: multi-chip sharded-MSM story and stays selectable via
+#: CPZK_PIPPENGER_MIN or the constructor for re-calibration on other
+#: silicon).
+PIPPENGER_MIN_ROWS = int(os.environ.get("CPZK_PIPPENGER_MIN", str(1 << 62)))
+
+#: Maximum lane count for one monolithic device program.  Measured on TPU
+#: v5 lite (benches/debug_pip16k.py, PROFILE.md §7a): the MSM kernel is
+#: bit-correct through 32,770 lanes and deterministically WRONG at 40,962+
+#: (internal XLA error at 49,154; all-zero output at 57,346), and the
+#: per-row combined kernel fails its in-kernel check at 65,538 rows while
+#: passing at 16,386 — an XLA codegen defect on large-lane programs, not
+#: a math bug (the identical code passes every CPU differential at every
+#: size).  Batches above this are tiled into equal chunks of this many
+#: lanes (one compile per chunk shape, partial points added at the end),
+#: which also cuts the 64k monolith's >18-minute compile.
+LANE_CHUNK = int(os.environ.get("CPZK_LANE_CHUNK", "16384"))
 
 
 def _pad_pow2(n: int) -> int:
@@ -47,6 +65,15 @@ def _pad_pow2(n: int) -> int:
     while size < n:
         size *= 2
     return size
+
+
+def _pad_lanes(n: int) -> int:
+    """Lane padding: next power of two up to LANE_CHUNK, then a multiple
+    of LANE_CHUNK (so over-limit batches split into identical chunk
+    shapes that share one compiled executable)."""
+    if n <= LANE_CHUNK:
+        return min(_pad_pow2(n), LANE_CHUNK)
+    return -(-n // LANE_CHUNK) * LANE_CHUNK
 
 
 def _points_soa(points: list[edwards.Point], pad: int) -> curve.Point:
@@ -189,6 +216,37 @@ def _msm_identity(c, points, digits):
     return msm.msm_is_identity_kernel(points, digits, c)
 
 
+@partial(jax.jit, static_argnums=(0,))
+def _combined_partial(n_pad, r1, y1, r2, y2, w_a, w_ac, w_ba, w_bac):
+    del n_pad
+    return verify.combined_partial_kernel(
+        r1, y1, r2, y2, w_a, w_ac, w_ba, w_bac)
+
+
+@partial(jax.jit, static_argnums=(0,))
+def _msm_partial(c, points, digits):
+    return msm.msm_kernel(points, digits, c)
+
+
+@jax.jit
+def _partials_are_identity(parts: curve.Point) -> jnp.ndarray:
+    """[20, k] partial points -> does their sum hit the identity coset."""
+    return curve.is_identity(curve.tree_sum(parts, axis=-1))
+
+
+def _chunk_point(pt: curve.Point, lo: int, hi: int) -> curve.Point:
+    """Lane-slice every coordinate array of a SoA point."""
+    return tuple(c[..., lo:hi] for c in pt)
+
+
+def _stack_partials(parts: list[curve.Point]) -> curve.Point:
+    """[20, 1] chunk partials -> one [20, k] point batch for the final
+    tree-sum + identity test."""
+    return tuple(
+        jnp.concatenate([p[k] for p in parts], axis=-1) for k in range(4)
+    )
+
+
 class TpuBackend(VerifierBackend):
     """Vectorized device backend (TPU when available, any JAX backend).
 
@@ -256,12 +314,14 @@ class TpuBackend(VerifierBackend):
         n = len(rows)
         device_rlc = os.environ.get("CPZK_DEVICE_RLC") == "1"
 
-        if n >= self._pippenger_min:
+        if self._sharded_msm is not None or n >= self._pippenger_min:
+            # a mesh always routes through the Pippenger MSM: the sharded
+            # combined check is the partial-bucket-psum path (SURVEY §2.3)
             return self._combined_pippenger(rows, beta, device_rlc)
 
         # correction row: G in slot r1 with -sum(a s), H in slot y1 with
         # -b sum(a s); identity in the other two slots.
-        pad = _pad_pow2(n + 1)
+        pad = _pad_lanes(n + 1)
         r1 = _elems_soa([r.r1 for r in rows] + [rows[0].g], pad)
         y1 = _elems_soa([r.y1 for r in rows] + [rows[0].h], pad)
         r2 = _elems_soa([r.r2 for r in rows], pad)
@@ -282,8 +342,21 @@ class TpuBackend(VerifierBackend):
             w_ba = _windows(ba, pad)
             w_bac = _windows(bac, pad)
 
-        ok = _combined(pad, r1, y1, r2, y2, w_a, w_ac, w_ba, w_bac)
-        return bool(ok)
+        if pad <= LANE_CHUNK:
+            ok = _combined(pad, r1, y1, r2, y2, w_a, w_ac, w_ba, w_bac)
+            return bool(ok)
+        # lane-chunked: identical chunk shapes share one executable; the
+        # identity-padded lanes contribute identity partials
+        parts = []
+        for lo in range(0, pad, LANE_CHUNK):
+            hi = lo + LANE_CHUNK
+            parts.append(_combined_partial(
+                LANE_CHUNK,
+                _chunk_point(r1, lo, hi), _chunk_point(y1, lo, hi),
+                _chunk_point(r2, lo, hi), _chunk_point(y2, lo, hi),
+                w_a[:, lo:hi], w_ac[:, lo:hi],
+                w_ba[:, lo:hi], w_bac[:, lo:hi]))
+        return bool(_partials_are_identity(_stack_partials(parts)))
 
     def _combined_pippenger(
         self, rows: list[BatchRow], beta: Scalar, device_rlc: bool
@@ -307,9 +380,13 @@ class TpuBackend(VerifierBackend):
         )
         m = 4 * _pad_pow2(len(rows)) + 2
         c = msm.pick_window(m)
-        pts = _elems_soa(elems, m)
+        # m is already shape-quantized (4*pow2+2), so below the chunk cap
+        # it is used EXACTLY — _pad_lanes would round the just-past-pow2
+        # term count up to ~2m and double the MSM's device work
+        m_pad = m if m <= LANE_CHUNK else -(-m // LANE_CHUNK) * LANE_CHUNK
+        pts = _elems_soa(elems, m_pad)
         if device_rlc:
-            digits = _pippenger_digits_device(rows, beta, m, c)
+            digits = _pippenger_digits_device(rows, beta, m_pad, c)
         else:
             b = beta.value
             a = [r.alpha.value for r in rows]
@@ -323,15 +400,25 @@ class TpuBackend(VerifierBackend):
                 (L - sum_as) % L, (L - b * sum_as % L) % L,
             ]
             digits = jnp.asarray(
-                msm.scalars_to_signed_digits(scalars + [0] * (m - len(scalars)), c)
+                msm.scalars_to_signed_digits(
+                    scalars + [0] * (m_pad - len(scalars)), c)
             )
         if self._sharded_msm is not None:
             return bool(self._sharded_msm(pts, digits, c))
-        return bool(_msm_identity(c, pts, digits))
+        if m_pad <= LANE_CHUNK:
+            return bool(_msm_identity(c, pts, digits))
+        # term-chunked MSM: each chunk's Horner sum is the partial sum of
+        # its terms (zero-digit padded terms contribute identity)
+        parts = []
+        for lo in range(0, m_pad, LANE_CHUNK):
+            hi = lo + LANE_CHUNK
+            parts.append(_msm_partial(
+                c, _chunk_point(pts, lo, hi), digits[:, lo:hi]))
+        return bool(_partials_are_identity(_stack_partials(parts)))
 
     def verify_each(self, rows: list[BatchRow]) -> list[bool]:
         n = len(rows)
-        pad = _pad_pow2(n)
+        pad = _pad_lanes(n)
         shared = all(r.g == rows[0].g and r.h == rows[0].h for r in rows)
         if shared:
             g, h = self._gh(rows[0])
@@ -347,6 +434,19 @@ class TpuBackend(VerifierBackend):
 
         if self._sharded_each is not None and shared:
             mask = self._sharded_each(g, h, y1, y2, r1, r2, ws, wc)
+        elif pad > LANE_CHUNK:
+            # per-row checks are lane-independent: tile and concatenate
+            chunks = []
+            for lo in range(0, pad, LANE_CHUNK):
+                hi = lo + LANE_CHUNK
+                cg = g if shared else _chunk_point(g, lo, hi)
+                ch_ = h if shared else _chunk_point(h, lo, hi)
+                chunks.append(_each_shared(
+                    LANE_CHUNK, cg, ch_,
+                    _chunk_point(y1, lo, hi), _chunk_point(y2, lo, hi),
+                    _chunk_point(r1, lo, hi), _chunk_point(r2, lo, hi),
+                    ws[:, lo:hi], wc[:, lo:hi]))
+            mask = jnp.concatenate(chunks, axis=-1)
         else:
             mask = _each_shared(pad, g, h, y1, y2, r1, r2, ws, wc)
         if hasattr(mask, "is_fully_addressable") and not mask.is_fully_addressable:

@@ -82,6 +82,33 @@ def verify_each_kernel(
     return curve.is_identity(d1) & curve.is_identity(d2)
 
 
+def combined_partial_kernel(
+    r1: Point,
+    y1: Point,
+    r2: Point,
+    y2: Point,
+    w_a: jnp.ndarray,
+    w_ac: jnp.ndarray,
+    w_ba: jnp.ndarray,
+    w_bac: jnp.ndarray,
+) -> Point:
+    """Partial sum of the combined check over one lane chunk -> [20, 1].
+
+    Identity-padded lanes (zero windows, identity points) contribute the
+    identity, so chunk partials add up to the full batch total.  Split out
+    from :func:`combined_kernel` so the backend can tile large batches
+    into lane chunks that stay inside the device's proven program size
+    (PROFILE.md §7a: monolithic >~33k-lane programs miscompile on TPU
+    v5 lite).
+    """
+    rows = _msm_rows(
+        [build_table(r1), build_table(y1), build_table(r2), build_table(y2)],
+        [w_a, w_ac, w_ba, w_bac],
+    )
+    total = curve.tree_sum(rows, axis=-1)
+    return tuple(c[..., None] for c in total)
+
+
 def combined_kernel(
     r1: Point,
     y1: Point,
@@ -98,9 +125,5 @@ def combined_kernel(
     ``-sum(a*s)``, ``-b*sum(a*s)``, 0, 0) before invoking, so acceptance is
     ``total == O``.
     """
-    rows = _msm_rows(
-        [build_table(r1), build_table(y1), build_table(r2), build_table(y2)],
-        [w_a, w_ac, w_ba, w_bac],
-    )
-    total = curve.tree_sum(rows, axis=-1)
-    return curve.is_identity(total)
+    total = combined_partial_kernel(r1, y1, r2, y2, w_a, w_ac, w_ba, w_bac)
+    return curve.is_identity(tuple(c[..., 0] for c in total))

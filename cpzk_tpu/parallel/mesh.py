@@ -120,11 +120,26 @@ def make_sharded_verify_each(mesh: Mesh):
     d = mesh.devices.size
 
     def call(g, h, y1, y2, r1, r2, ws, wc):
+        from ..ops import backend as _backend  # lazy: no import cycle
+
         n = ws.shape[-1]
-        n_to = -(-n // d) * d
+        # keep every per-device program at or under LANE_CHUNK lanes (the
+        # TPU large-lane miscompile bound, ops/backend.py) by feeding the
+        # mesh in slices of d * LANE_CHUNK rows when needed
+        step = d * _backend.LANE_CHUNK
+        n_to = -(-n // d) * d if n <= step else -(-n // step) * step
         y1, y2, r1, r2 = (pad_to_multiple(p, n_to) for p in (y1, y2, r1, r2))
         ws, wc = pad_windows(ws, n_to), pad_windows(wc, n_to)
-        return fn(g, h, y1, y2, r1, r2, ws, wc)[:n]
+        if n_to <= step:
+            return fn(g, h, y1, y2, r1, r2, ws, wc)[:n]
+        chunks = []
+        for lo in range(0, n_to, step):
+            hi = lo + step
+            chunks.append(fn(
+                g, h,
+                *(tuple(c[..., lo:hi] for c in p) for p in (y1, y2, r1, r2)),
+                ws[:, lo:hi], wc[:, lo:hi]))
+        return jnp.concatenate(chunks, axis=-1)[:n]
 
     return call
 
@@ -267,22 +282,33 @@ def make_sharded_msm_check(mesh: Mesh):
             out_specs=_point_specs(P(None, AXIS)),
             check_rep=False,
         )
-
-        def check(points, digits):
-            partials = fn(points, digits)  # [20, D]
-            total = curve.tree_sum(partials, axis=-1)
-            return curve.is_identity(total)
-
-        return jax.jit(check)
+        return jax.jit(fn)  # (points, digits) -> [20, D] partial points
 
     def call(points, digits, c: int):
+        from ..ops import backend as _backend  # lazy: no import cycle
+
         m = digits.shape[-1]
-        m_to = -(-m // d) * d
+        # cap per-device lanes at LANE_CHUNK (the TPU large-lane
+        # miscompile bound, ops/backend.py): over-cap MSMs run as slices
+        # of d * LANE_CHUNK terms whose [20, D] partials concatenate into
+        # one final tree-sum + identity test
+        step = d * _backend.LANE_CHUNK
+        m_to = -(-m // d) * d if m <= step else -(-m // step) * step
         points = pad_to_multiple(points, m_to)
         digits = pad_windows(digits, m_to)
         if c not in cache:
             cache[c] = build(c)
-        return cache[c](points, digits)
+        fn = cache[c]
+        if m_to <= step:
+            partials = fn(points, digits)
+        else:
+            parts = [
+                fn(tuple(cd[..., lo:hi] for cd in points), digits[:, lo:hi])
+                for lo, hi in (
+                    (lo, lo + step) for lo in range(0, m_to, step))
+            ]
+            partials = _backend._stack_partials(parts)
+        return _backend._partials_are_identity(partials)
 
     return call
 

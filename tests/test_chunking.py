@@ -1,0 +1,121 @@
+"""Lane-chunked dispatch differentials (the PROFILE.md §7a workaround).
+
+On TPU v5 lite, monolithic device programs past ~33k lanes miscompile:
+deterministic wrong MSM output at m>=40,962, an internal XLA error at
+49,154, all-zero output buffers at 57,346 (benches/debug_pip16k.py),
+and the per-row combined kernel fails its in-kernel check at 65,538
+rows.  The backend therefore tiles large batches into ``LANE_CHUNK``-lane
+programs and adds partial points (``ops/backend.py``).
+
+These tests force MULTI-chunk execution with a tiny chunk size on the
+CPU backend and require bit-identical accept/reject against the host
+oracle — the same differential bar as tests/test_tpu_backend.py
+(reference semantics: ``src/verifier/batch.rs:171-318``).
+"""
+
+import pytest
+
+from cpzk_tpu import BatchVerifier, SecureRng, Statement, Witness
+from cpzk_tpu.core.ristretto import Ristretto255
+from cpzk_tpu.ops import backend as backend_mod
+from cpzk_tpu.ops.backend import TpuBackend, _pad_lanes
+from cpzk_tpu.protocol.batch import CpuBackend
+
+from test_tpu_backend import make_entries
+
+
+@pytest.fixture
+def tiny_chunks(monkeypatch):
+    monkeypatch.setattr(backend_mod, "LANE_CHUNK", 8)
+
+
+def _run(backend, entries):
+    bv = BatchVerifier(backend=backend)
+    for p, st, pr in entries:
+        bv.add(p, st, pr)
+    return [e is None for e in bv.verify(SecureRng())]
+
+
+def test_pad_lanes_schedule(tiny_chunks):
+    assert _pad_lanes(5) == 8
+    assert _pad_lanes(8) == 8
+    assert _pad_lanes(9) == 16
+    assert _pad_lanes(17) == 24
+    assert _pad_lanes(24) == 24
+
+
+def test_chunked_rowcombined_accepts_valid_batch(tiny_chunks):
+    # n+1 = 21 lanes -> 3 chunks of 8 through combined_partial_kernel
+    entries = make_entries(20)
+    assert _run(TpuBackend(), entries) == [True] * 20
+
+
+def test_chunked_rowcombined_mixed_matches_oracle(tiny_chunks):
+    entries = make_entries(20)
+    rng = SecureRng()
+    params = entries[7][0]
+    wrong = Statement.from_witness(params, Witness(Ristretto255.random_scalar(rng)))
+    entries[7] = (params, wrong, entries[7][2])
+    expect = _run(CpuBackend(), entries)
+    # the combined check fails -> the chunked verify_each fallback decides
+    assert _run(TpuBackend(), entries) == expect
+    assert expect == [i != 7 for i in range(20)]
+
+
+def test_chunked_pippenger_accepts_valid_batch(monkeypatch):
+    # m = 4*pad_pow2(20)+2 = 130 terms -> 5 chunks of 32 through _msm_partial
+    monkeypatch.setattr(backend_mod, "LANE_CHUNK", 32)
+    entries = make_entries(20)
+    assert _run(TpuBackend(pippenger_min=2), entries) == [True] * 20
+
+
+def test_chunked_pippenger_mixed_matches_oracle(monkeypatch):
+    monkeypatch.setattr(backend_mod, "LANE_CHUNK", 32)
+    entries = make_entries(12)
+    rng = SecureRng()
+    params = entries[3][0]
+    wrong = Statement.from_witness(params, Witness(Ristretto255.random_scalar(rng)))
+    entries[3] = (params, wrong, entries[3][2])
+    expect = _run(CpuBackend(), entries)
+    assert _run(TpuBackend(pippenger_min=2), entries) == expect
+    assert expect == [i != 3 for i in range(12)]
+
+
+def test_chunked_pippenger_device_rlc(monkeypatch):
+    monkeypatch.setattr(backend_mod, "LANE_CHUNK", 32)
+    monkeypatch.setenv("CPZK_DEVICE_RLC", "1")
+    entries = make_entries(10)
+    assert _run(TpuBackend(pippenger_min=2), entries) == [True] * 10
+
+
+def test_chunked_rowcombined_device_rlc(tiny_chunks, monkeypatch):
+    """Device-RLC windows are built full-width (correction spliced at lane
+    n, possibly inside a middle chunk) and then chunk-sliced — the layout
+    must survive the tiling."""
+    monkeypatch.setenv("CPZK_DEVICE_RLC", "1")
+    entries = make_entries(20)  # correction lane lands at 20, chunk 3 of 3
+    assert _run(TpuBackend(), entries) == [True] * 20
+    entries = make_entries(11)  # correction lane 11 inside chunk 2 of 2
+    assert _run(TpuBackend(), entries) == [True] * 11
+
+
+def test_mesh_chunked_paths(monkeypatch):
+    """Sharded mesh paths under the per-device lane cap: the sharded MSM
+    (combined) and sharded verify_each both split into mesh-sized slices
+    of d * LANE_CHUNK lanes and must stay bit-identical to the oracle."""
+    monkeypatch.setattr(backend_mod, "LANE_CHUNK", 4)
+    entries = make_entries(40)
+    be = TpuBackend(mesh_devices=0)  # the 8-virtual-device CPU mesh
+    if be._mesh is None:
+        pytest.skip("no multi-device mesh available")
+    # combined: m = 4*pad_pow2(40)+2 = 258 terms, step 8*4=32 -> 9 slices
+    assert _run(be, entries) == [True] * 40
+
+    rng = SecureRng()
+    params = entries[11][0]
+    wrong = Statement.from_witness(params, Witness(Ristretto255.random_scalar(rng)))
+    entries[11] = (params, wrong, entries[11][2])
+    # combined fails -> sharded verify_each (n=40, step 32 -> 2 slices)
+    expect = _run(CpuBackend(), entries)
+    assert _run(TpuBackend(mesh_devices=0), entries) == expect
+    assert expect == [i != 11 for i in range(40)]

@@ -109,16 +109,19 @@ def test_pick_window_grows_with_m():
 
 
 def test_backend_pippenger_path():
-    """BatchVerifier + TpuBackend at n >= PIPPENGER_MIN_ROWS: valid batch
-    accepts via the MSM; a corrupted row falls back to per-proof results."""
+    """BatchVerifier + TpuBackend routed through the Pippenger MSM: valid
+    batch accepts; a corrupted row falls back to per-proof results.  The
+    single-device default never picks Pippenger (calibrated loser on
+    silicon, ``backend.PIPPENGER_MIN_ROWS``), so the crossover is pinned
+    low explicitly here."""
     from cpzk_tpu import BatchVerifier, Parameters, Prover, SecureRng, Transcript, Witness
     from cpzk_tpu.core.ristretto import Ristretto255
-    from cpzk_tpu.ops.backend import PIPPENGER_MIN_ROWS, TpuBackend
+    from cpzk_tpu.ops.backend import TpuBackend
 
     rng = SecureRng()
     params = Parameters.new()
-    n = PIPPENGER_MIN_ROWS + 3
-    bv = BatchVerifier(backend=TpuBackend())
+    n = 35
+    bv = BatchVerifier(backend=TpuBackend(pippenger_min=32))
     proofs = []
     for _ in range(n):
         prover = Prover(params, Witness(Ristretto255.random_scalar(rng)))
@@ -128,7 +131,7 @@ def test_backend_pippenger_path():
     assert bv.verify(rng) == [None] * n
 
     # corrupt one row: statement/proof mismatch -> combined fails -> fallback
-    bad = BatchVerifier(backend=TpuBackend())
+    bad = BatchVerifier(backend=TpuBackend(pippenger_min=32))
     for i, (st, pr) in enumerate(proofs):
         other = proofs[0][1] if i == n - 1 else pr
         bad.add(params, st, other if i == n - 1 else pr)
