@@ -182,13 +182,19 @@ def _pippenger_setup(inp: _Inputs):
     from cpzk_tpu.ops.backend import _pad_pow2
 
     # pad the row count (not the term count): 4*pow2(N)+2 terms, ~0% waste
+    from cpzk_tpu.ops import backend as B
+
     m_used = 4 * N + 2
     m = 4 * _pad_pow2(N) + 2
     c = msm.pick_window(m)
+    # mirror the production dispatch (ops/backend._combined_pippenger):
+    # past LANE_CHUNK the MSM runs as identical per-chunk programs whose
+    # partial points tree-sum into one identity test
+    m_pad = m if m <= B.LANE_CHUNK else B._pad_lanes(m)
     scalars = inp.a + inp.ac + inp.ba + inp.bac + inp.corr
-    digits = msm.scalars_to_signed_digits(scalars + [0] * (m - m_used), c)
+    digits = msm.scalars_to_signed_digits(scalars + [0] * (m_pad - m_used), c)
 
-    ident = identity_cols(m - m_used)
+    ident = identity_cols(m_pad - m_used)
     pts = tuple(
         jnp.asarray(
             np.concatenate(
@@ -201,8 +207,18 @@ def _pippenger_setup(inp: _Inputs):
         for i in range(4)
     )
     dig = jnp.asarray(digits)
-    kernel = jax.jit(msm.msm_is_identity_kernel, static_argnums=2)
-    return (lambda p, d: kernel(p, d, c)), (pts, dig)
+    if m_pad <= B.LANE_CHUNK:
+        kernel = jax.jit(msm.msm_is_identity_kernel, static_argnums=2)
+        return (lambda p, d: kernel(p, d, c)), (pts, dig)
+
+    def fn(p, d):
+        parts = []
+        for lo, hi in B._chunk_bounds(m_pad):
+            parts.append(B._msm_partial(
+                c, B._chunk_point(p, lo, hi), d[:, lo:hi]))
+        return B._partials_are_identity(B._stack_partials(parts))
+
+    return fn, (pts, dig)
 
 
 def bench_pippenger(inp: _Inputs) -> float:
@@ -222,10 +238,17 @@ def _rowcombined_setup(inp: _Inputs):
 
     from cpzk_tpu.ops import curve, verify
 
+    from cpzk_tpu.ops import backend as B
+
     # correction row is folded in as row N+1 (G with -sum(a s) in the r1
-    # slot, H with -b sum(a s) in the y1 slot); pad one more identity row
-    # to keep the lane count even.
-    ident = identity_cols(1)
+    # slot, H with -b sum(a s) in the y1 slot); identity rows pad to the
+    # production lane schedule (ops/backend._pad_lanes): chunked past
+    # LANE_CHUNK, mirroring TpuBackend.verify_combined.
+    lanes = N + 1
+    pad = B._pad_lanes(lanes)
+    npad = pad - lanes
+    ident = identity_cols(npad)          # post-correction padding rows
+    identc = identity_cols(npad + 1)     # identity corr slot + padding
 
     # build per-slot arrays with the correction column appended
     r1 = tuple(
@@ -240,24 +263,39 @@ def _rowcombined_setup(inp: _Inputs):
     )
     r2 = tuple(
         jnp.asarray(np.concatenate(
-            [inp.tile(inp.r2c[i]), ident[i], ident[i]], axis=1))
+            [inp.tile(inp.r2c[i]), identc[i]], axis=1))
         for i in range(4)
     )
     y2 = tuple(
         jnp.asarray(np.concatenate(
-            [inp.tile(inp.y2c[i]), ident[i], ident[i]], axis=1))
+            [inp.tile(inp.y2c[i]), identc[i]], axis=1))
         for i in range(4)
     )
 
     from cpzk_tpu.ops.curve import scalars_to_windows
 
-    w_a = jnp.asarray(scalars_to_windows(inp.a + [inp.corr[0], 0]))
-    w_ac = jnp.asarray(scalars_to_windows(inp.ac + [inp.corr[1], 0]))
-    w_ba = jnp.asarray(scalars_to_windows(inp.ba + [0, 0]))
-    w_bac = jnp.asarray(scalars_to_windows(inp.bac + [0, 0]))
+    zeros = [0] * npad
+    w_a = jnp.asarray(scalars_to_windows(inp.a + [inp.corr[0]] + zeros))
+    w_ac = jnp.asarray(scalars_to_windows(inp.ac + [inp.corr[1]] + zeros))
+    w_ba = jnp.asarray(scalars_to_windows(inp.ba + [0] + zeros))
+    w_bac = jnp.asarray(scalars_to_windows(inp.bac + [0] + zeros))
 
-    kernel = jax.jit(verify.combined_kernel)
-    return kernel, (r1, y1, r2, y2, w_a, w_ac, w_ba, w_bac)
+    if pad <= B.LANE_CHUNK:
+        kernel = jax.jit(verify.combined_kernel)
+        return kernel, (r1, y1, r2, y2, w_a, w_ac, w_ba, w_bac)
+
+    def fn(r1_, y1_, r2_, y2_, wa, wac, wba, wbac):
+        parts = []
+        for lo, hi in B._chunk_bounds(pad):
+            parts.append(B._combined_partial(
+                hi - lo,
+                B._chunk_point(r1_, lo, hi), B._chunk_point(y1_, lo, hi),
+                B._chunk_point(r2_, lo, hi), B._chunk_point(y2_, lo, hi),
+                wa[:, lo:hi], wac[:, lo:hi],
+                wba[:, lo:hi], wbac[:, lo:hi]))
+        return B._partials_are_identity(B._stack_partials(parts))
+
+    return fn, (r1, y1, r2, y2, w_a, w_ac, w_ba, w_bac)
 
 
 def _emit(value: float, diagnostic: str | None = None,

@@ -54,10 +54,19 @@ PIPPENGER_MIN_ROWS = int(os.environ.get("CPZK_PIPPENGER_MIN", str(1 << 62)))
 #: per-row combined kernel fails its in-kernel check at 65,538 rows while
 #: passing at 16,386 — an XLA codegen defect on large-lane programs, not
 #: a math bug (the identical code passes every CPU differential at every
-#: size).  Batches above this are tiled into equal chunks of this many
-#: lanes (one compile per chunk shape, partial points added at the end),
-#: which also cuts the 64k monolith's >18-minute compile.
+#: size).  Batches above this are tiled into full chunks of this many
+#: lanes plus one quantum-aligned remainder chunk (one compile per chunk
+#: shape, partial points added at the end), which also cuts the 64k
+#: monolith's >18-minute compile.
 LANE_CHUNK = int(os.environ.get("CPZK_LANE_CHUNK", "16384"))
+
+#: Lane-pad granularity past the pow2 range.  Pure pow2 padding doubles
+#: the device work for just-past-pow2 batches (the ubiquitous N+1
+#: correction-row case: 16,385 -> 32,768); quantum padding caps the waste
+#: at <= QUANTUM-1 lanes (~3% at 64k) while keeping the jit cache bounded
+#: (one shared full-chunk program + at most LANE_CHUNK/QUANTUM remainder
+#: shapes).
+LANE_QUANTUM = int(os.environ.get("CPZK_LANE_QUANTUM", "2048"))
 
 
 def _pad_pow2(n: int) -> int:
@@ -68,12 +77,24 @@ def _pad_pow2(n: int) -> int:
 
 
 def _pad_lanes(n: int) -> int:
-    """Lane padding: next power of two up to LANE_CHUNK, then a multiple
-    of LANE_CHUNK (so over-limit batches split into identical chunk
-    shapes that share one compiled executable)."""
-    if n <= LANE_CHUNK:
-        return min(_pad_pow2(n), LANE_CHUNK)
-    return -(-n // LANE_CHUNK) * LANE_CHUNK
+    """Lane padding schedule: powers of two while small (compile-cache
+    friendly), then multiples of LANE_QUANTUM.  Chunking slices the
+    result into LANE_CHUNK-lane programs plus one quantum-aligned
+    remainder program (see ``_chunk_bounds``)."""
+    q = min(LANE_QUANTUM, LANE_CHUNK)
+    if n <= q:
+        return _pad_pow2(n)
+    return -(-n // q) * q
+
+
+def _chunk_bounds(pad: int):
+    """(lo, hi) slices of a padded lane axis: full LANE_CHUNK chunks plus
+    one remainder chunk (a LANE_QUANTUM multiple by construction)."""
+    lo = 0
+    while lo < pad:
+        hi = min(lo + LANE_CHUNK, pad)
+        yield lo, hi
+        lo = hi
 
 
 def _points_soa(points: list[edwards.Point], pad: int) -> curve.Point:
@@ -348,10 +369,9 @@ class TpuBackend(VerifierBackend):
         # lane-chunked: identical chunk shapes share one executable; the
         # identity-padded lanes contribute identity partials
         parts = []
-        for lo in range(0, pad, LANE_CHUNK):
-            hi = lo + LANE_CHUNK
+        for lo, hi in _chunk_bounds(pad):
             parts.append(_combined_partial(
-                LANE_CHUNK,
+                hi - lo,
                 _chunk_point(r1, lo, hi), _chunk_point(y1, lo, hi),
                 _chunk_point(r2, lo, hi), _chunk_point(y2, lo, hi),
                 w_a[:, lo:hi], w_ac[:, lo:hi],
@@ -381,9 +401,9 @@ class TpuBackend(VerifierBackend):
         m = 4 * _pad_pow2(len(rows)) + 2
         c = msm.pick_window(m)
         # m is already shape-quantized (4*pow2+2), so below the chunk cap
-        # it is used EXACTLY — _pad_lanes would round the just-past-pow2
-        # term count up to ~2m and double the MSM's device work
-        m_pad = m if m <= LANE_CHUNK else -(-m // LANE_CHUNK) * LANE_CHUNK
+        # it is used EXACTLY; above it, quantum padding keeps the waste to
+        # under one LANE_QUANTUM of identity terms
+        m_pad = m if m <= LANE_CHUNK else _pad_lanes(m)
         pts = _elems_soa(elems, m_pad)
         if device_rlc:
             digits = _pippenger_digits_device(rows, beta, m_pad, c)
@@ -410,8 +430,7 @@ class TpuBackend(VerifierBackend):
         # term-chunked MSM: each chunk's Horner sum is the partial sum of
         # its terms (zero-digit padded terms contribute identity)
         parts = []
-        for lo in range(0, m_pad, LANE_CHUNK):
-            hi = lo + LANE_CHUNK
+        for lo, hi in _chunk_bounds(m_pad):
             parts.append(_msm_partial(
                 c, _chunk_point(pts, lo, hi), digits[:, lo:hi]))
         return bool(_partials_are_identity(_stack_partials(parts)))
@@ -437,12 +456,11 @@ class TpuBackend(VerifierBackend):
         elif pad > LANE_CHUNK:
             # per-row checks are lane-independent: tile and concatenate
             chunks = []
-            for lo in range(0, pad, LANE_CHUNK):
-                hi = lo + LANE_CHUNK
+            for lo, hi in _chunk_bounds(pad):
                 cg = g if shared else _chunk_point(g, lo, hi)
                 ch_ = h if shared else _chunk_point(h, lo, hi)
                 chunks.append(_each_shared(
-                    LANE_CHUNK, cg, ch_,
+                    hi - lo, cg, ch_,
                     _chunk_point(y1, lo, hi), _chunk_point(y2, lo, hi),
                     _chunk_point(r1, lo, hi), _chunk_point(r2, lo, hi),
                     ws[:, lo:hi], wc[:, lo:hi]))
