@@ -173,16 +173,12 @@ def _pippenger_setup(inp: _Inputs):
     """Build device inputs + jitted kernel -> (fn, args); shared by the
     timed bench and the xprof capture (which must set up OUTSIDE its
     trace window)."""
-    import jax
     import numpy as np
     import jax.numpy as jnp
 
     from cpzk_tpu.ops import msm
-
-    from cpzk_tpu.ops.backend import _pad_pow2
-
-    # pad the row count (not the term count): 4*pow2(N)+2 terms, ~0% waste
     from cpzk_tpu.ops import backend as B
+    from cpzk_tpu.ops.backend import _pad_pow2
 
     m_used = 4 * N + 2
     m = 4 * _pad_pow2(N) + 2
@@ -207,18 +203,9 @@ def _pippenger_setup(inp: _Inputs):
         for i in range(4)
     )
     dig = jnp.asarray(digits)
-    if m_pad <= B.LANE_CHUNK:
-        kernel = jax.jit(msm.msm_is_identity_kernel, static_argnums=2)
-        return (lambda p, d: kernel(p, d, c)), (pts, dig)
-
-    def fn(p, d):
-        parts = []
-        for lo, hi in B._chunk_bounds(m_pad):
-            parts.append(B._msm_partial(
-                c, B._chunk_point(p, lo, hi), d[:, lo:hi]))
-        return B._partials_are_identity(B._stack_partials(parts))
-
-    return fn, (pts, dig)
+    # the SHARED production dispatch (chunk schedule included): the bench
+    # times exactly what TpuBackend serves
+    return (lambda p, d: B.chunked_msm_identity(c, p, d)), (pts, dig)
 
 
 def bench_pippenger(inp: _Inputs) -> float:
@@ -232,11 +219,8 @@ def bench_rowcombined(inp: _Inputs) -> float:
 
 
 def _rowcombined_setup(inp: _Inputs):
-    import jax
     import numpy as np
     import jax.numpy as jnp
-
-    from cpzk_tpu.ops import curve, verify
 
     from cpzk_tpu.ops import backend as B
 
@@ -280,20 +264,11 @@ def _rowcombined_setup(inp: _Inputs):
     w_ba = jnp.asarray(scalars_to_windows(inp.ba + [0] + zeros))
     w_bac = jnp.asarray(scalars_to_windows(inp.bac + [0] + zeros))
 
-    if pad <= B.LANE_CHUNK:
-        kernel = jax.jit(verify.combined_kernel)
-        return kernel, (r1, y1, r2, y2, w_a, w_ac, w_ba, w_bac)
-
+    # the SHARED production dispatch (chunk schedule included): the bench
+    # times exactly what TpuBackend serves
     def fn(r1_, y1_, r2_, y2_, wa, wac, wba, wbac):
-        parts = []
-        for lo, hi in B._chunk_bounds(pad):
-            parts.append(B._combined_partial(
-                hi - lo,
-                B._chunk_point(r1_, lo, hi), B._chunk_point(y1_, lo, hi),
-                B._chunk_point(r2_, lo, hi), B._chunk_point(y2_, lo, hi),
-                wa[:, lo:hi], wac[:, lo:hi],
-                wba[:, lo:hi], wbac[:, lo:hi]))
-        return B._partials_are_identity(B._stack_partials(parts))
+        return B.chunked_combined_identity(
+            pad, r1_, y1_, r2_, y2_, wa, wac, wba, wbac)
 
     return fn, (r1, y1, r2, y2, w_a, w_ac, w_ba, w_bac)
 

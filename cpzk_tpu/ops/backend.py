@@ -5,8 +5,9 @@ Host side: scalar arithmetic mod l (Python ints are exact and cheap relative
 to group ops), window/digit decomposition, and SoA limb marshalling of the
 row points.  Device side: the batched kernels in :mod:`cpzk_tpu.ops.verify`
 and the windowed-Pippenger MSM in :mod:`cpzk_tpu.ops.msm`.  Batch shapes
-are padded to powers of two so ``jax.jit`` caches a handful of programs
-instead of one per batch size.
+follow the ``_pad_lanes`` schedule — powers of two up to ``LANE_QUANTUM``,
+then quantum multiples — so ``jax.jit`` caches a bounded program set
+without pow2's 2x padding waste at just-past-pow2 sizes.
 
 The combined RLC check dispatches by topology: single-device batches use
 the per-row shared-doubling kernel at EVERY size (calibrated winner on TPU
@@ -67,6 +68,18 @@ LANE_CHUNK = int(os.environ.get("CPZK_LANE_CHUNK", "16384"))
 #: (one shared full-chunk program + at most LANE_CHUNK/QUANTUM remainder
 #: shapes).
 LANE_QUANTUM = int(os.environ.get("CPZK_LANE_QUANTUM", "2048"))
+if LANE_CHUNK % min(LANE_QUANTUM, LANE_CHUNK):
+    # a chunk that is not a quantum multiple makes every remainder shape
+    # batch-size-dependent — one fresh minutes-long XLA compile each,
+    # defeating the bounded-cache design; round down once, loudly
+    import warnings
+
+    _rounded = LANE_CHUNK - LANE_CHUNK % LANE_QUANTUM
+    warnings.warn(
+        f"CPZK_LANE_CHUNK={LANE_CHUNK} is not a multiple of "
+        f"LANE_QUANTUM={LANE_QUANTUM}; rounding down to {_rounded} to keep "
+        "remainder-chunk shapes bounded", stacklevel=1)
+    LANE_CHUNK = _rounded
 
 
 def _pad_pow2(n: int) -> int:
@@ -268,6 +281,41 @@ def _stack_partials(parts: list[curve.Point]) -> curve.Point:
     )
 
 
+def chunked_combined_identity(pad, r1, y1, r2, y2,
+                              w_a, w_ac, w_ba, w_bac) -> bool:
+    """The full chunked per-row combined check: LANE_CHUNK-lane partial
+    programs (identity-padded lanes contribute identity partials), then
+    one tree-sum + identity test.  The SINGLE implementation of the
+    chunk schedule — TpuBackend serves it and bench.py times it, so the
+    bench cannot drift from the shipped dispatch."""
+    if pad <= LANE_CHUNK:
+        return bool(_combined(pad, r1, y1, r2, y2, w_a, w_ac, w_ba, w_bac))
+    parts = []
+    for lo, hi in _chunk_bounds(pad):
+        parts.append(_combined_partial(
+            hi - lo,
+            _chunk_point(r1, lo, hi), _chunk_point(y1, lo, hi),
+            _chunk_point(r2, lo, hi), _chunk_point(y2, lo, hi),
+            w_a[:, lo:hi], w_ac[:, lo:hi],
+            w_ba[:, lo:hi], w_bac[:, lo:hi]))
+    return bool(_partials_are_identity(_stack_partials(parts)))
+
+
+def chunked_msm_identity(c: int, pts: curve.Point,
+                         digits: jnp.ndarray) -> bool:
+    """The full chunked MSM == identity check (term axis tiled; zero-digit
+    padded terms contribute identity).  Shared by TpuBackend and bench.py
+    for the same no-drift reason as :func:`chunked_combined_identity`."""
+    m_pad = digits.shape[-1]
+    if m_pad <= LANE_CHUNK:
+        return bool(_msm_identity(c, pts, digits))
+    parts = []
+    for lo, hi in _chunk_bounds(m_pad):
+        parts.append(_msm_partial(
+            c, _chunk_point(pts, lo, hi), digits[:, lo:hi]))
+    return bool(_partials_are_identity(_stack_partials(parts)))
+
+
 class TpuBackend(VerifierBackend):
     """Vectorized device backend (TPU when available, any JAX backend).
 
@@ -363,20 +411,8 @@ class TpuBackend(VerifierBackend):
             w_ba = _windows(ba, pad)
             w_bac = _windows(bac, pad)
 
-        if pad <= LANE_CHUNK:
-            ok = _combined(pad, r1, y1, r2, y2, w_a, w_ac, w_ba, w_bac)
-            return bool(ok)
-        # lane-chunked: identical chunk shapes share one executable; the
-        # identity-padded lanes contribute identity partials
-        parts = []
-        for lo, hi in _chunk_bounds(pad):
-            parts.append(_combined_partial(
-                hi - lo,
-                _chunk_point(r1, lo, hi), _chunk_point(y1, lo, hi),
-                _chunk_point(r2, lo, hi), _chunk_point(y2, lo, hi),
-                w_a[:, lo:hi], w_ac[:, lo:hi],
-                w_ba[:, lo:hi], w_bac[:, lo:hi]))
-        return bool(_partials_are_identity(_stack_partials(parts)))
+        return chunked_combined_identity(
+            pad, r1, y1, r2, y2, w_a, w_ac, w_ba, w_bac)
 
     def _combined_pippenger(
         self, rows: list[BatchRow], beta: Scalar, device_rlc: bool
@@ -425,15 +461,7 @@ class TpuBackend(VerifierBackend):
             )
         if self._sharded_msm is not None:
             return bool(self._sharded_msm(pts, digits, c))
-        if m_pad <= LANE_CHUNK:
-            return bool(_msm_identity(c, pts, digits))
-        # term-chunked MSM: each chunk's Horner sum is the partial sum of
-        # its terms (zero-digit padded terms contribute identity)
-        parts = []
-        for lo, hi in _chunk_bounds(m_pad):
-            parts.append(_msm_partial(
-                c, _chunk_point(pts, lo, hi), digits[:, lo:hi]))
-        return bool(_partials_are_identity(_stack_partials(parts)))
+        return chunked_msm_identity(c, pts, digits)
 
     def verify_each(self, rows: list[BatchRow]) -> list[bool]:
         n = len(rows)
