@@ -103,37 +103,15 @@ def derive_challenges_batch(
     """
     from .ristretto import Scalar
 
-    # Opt-in device path (CPZK_DEVICE_CHALLENGES=1): batched Keccak on the
-    # accelerator (ops/challenge.py).  Requires a uniform context shape —
-    # all None, or all the same length (the serving case: 32-byte
-    # challenge ids); ragged batches fall through to the native pool.
-    import os
-
-    if os.environ.get("CPZK_DEVICE_CHALLENGES") == "1" and contexts:
-        uniform = all(c is None for c in contexts) or (
-            all(c is not None for c in contexts)
-            and len({len(c) for c in contexts if c is not None}) == 1
-        )
-        if uniform:
-            import numpy as np
-
-            from ..ops.challenge import derive_challenges_device
-
-            def cols(xs):
-                blob = b"".join(xs)
-                if not blob:  # uniform zero-length (b"") contexts
-                    return np.zeros((len(xs), 0), dtype=np.uint8)
-                return np.frombuffer(blob, dtype=np.uint8).reshape(len(xs), -1)
-
-            ctx = None if contexts[0] is None else cols(contexts)  # type: ignore[arg-type]
-            chal = derive_challenges_device(
-                ctx, cols(gs), cols(hs), cols(y1s), cols(y2s), cols(r1s), cols(r2s)
-            )
-            return [
-                Scalar(sc_from_bytes_mod_order_wide(chal[i].tobytes()))
-                for i in range(len(contexts))
-            ]
-
+    # A device (batched-Keccak) path existed here behind
+    # CPZK_DEVICE_CHALLENGES=1 and was REMOVED after round-5 hardware
+    # calibration: on TPU v5 lite the device Keccak measured 10.3 kchal/s
+    # at n=4096 and 23.3 kchal/s at n=65536 vs 383-443 kchal/s for the
+    # threaded native pool below — 18-37x slower at every tier, with no
+    # projected crossover (the serving plane needs ~25 kchal/s per 25k
+    # proofs/s, which one host core already triples).  The kernel itself
+    # survives as :mod:`cpzk_tpu.ops.challenge` (device Keccak-f[1600]
+    # twin, differential-tested) for silicon where the trade flips.
     out = _native.challenge_batch(
         contexts,
         b"".join(gs), b"".join(hs),

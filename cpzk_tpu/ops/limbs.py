@@ -254,8 +254,11 @@ def neg(a: jnp.ndarray) -> jnp.ndarray:
 #: fused anti-diagonal reduce) or "matmulfold" (the fold expressed as a
 #: shared-matrix dot_general — the MXU-mapping experiment, see
 #: ``_mul_matmulfold``).  Both are bit-exact (differential tests in
-#: tests/test_ops_limbs.py); the knob exists for on-hardware A/B
-#: (VERDICT r2 item 2).  A one-level Karatsuba variant was built and
+#: tests/test_ops_limbs.py).  CALIBRATED on TPU v5 lite (round-5 .hw/
+#: sweep): matmulfold +13% at n=4096 (534 vs 472 Mmul/s) but -1.5% at
+#: n=65536 (23.30 vs 23.66 GMul/s) — the MXU edge vanishes once the
+#: vector lanes fill, so schoolbook stays the default and the flag
+#: remains for A/B on other silicon.  A one-level Karatsuba variant was built and
 #: REMOVED: with the loose carried-form bound (|limb| <= ~9500) the
 #: subtractive middle product's anti-diagonal sums reach
 #: 10*(2*9500)^2 = 3.61e9 > int32, and the carry passes needed to

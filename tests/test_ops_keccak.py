@@ -110,25 +110,34 @@ def test_device_challenge_odd_context_length():
         assert got[i].tobytes() == t.challenge_bytes(CHALLENGE_DST, 64), i
 
 
-def test_device_challenge_path_in_derive_batch(monkeypatch):
-    """CPZK_DEVICE_CHALLENGES=1 routes derive_challenges_batch through the
-    device pipeline with identical Scalars (uniform, empty, and ragged
-    context shapes; ragged falls back)."""
+def test_device_challenges_match_host_batch_api():
+    """The device Keccak pipeline produces the same Scalars as the host
+    ``derive_challenges_batch`` (uniform and empty context shapes).  The
+    serving wiring for this path (CPZK_DEVICE_CHALLENGES) was removed
+    after round-5 calibration measured it 18-37x slower than the native
+    pool at every tier; the kernel stays correct and covered here for
+    silicon where the trade flips."""
     import secrets
 
+    import numpy as np
+
+    from cpzk_tpu.core.scalars import sc_from_bytes_mod_order_wide
     from cpzk_tpu.core.transcript import derive_challenges_batch
+    from cpzk_tpu.ops.challenge import derive_challenges_device
 
     n = 6
     mk = lambda: [secrets.token_bytes(32) for _ in range(n)]
     cols = [mk() for _ in range(6)]
-    for contexts in (
-        [None] * n,
-        [b"X" * 32] * n,
-        [b""] * n,
-        [secrets.token_bytes(i + 1) for i in range(n)],  # ragged -> fallback
-    ):
+
+    def as_cols(xs):
+        blob = b"".join(xs)
+        if not blob:
+            return np.zeros((len(xs), 0), dtype=np.uint8)
+        return np.frombuffer(blob, dtype=np.uint8).reshape(len(xs), -1)
+
+    for contexts in ([None] * n, [b"X" * 32] * n, [b""] * n):
         expected = derive_challenges_batch(contexts, *cols)
-        monkeypatch.setenv("CPZK_DEVICE_CHALLENGES", "1")
-        got = derive_challenges_batch(contexts, *cols)
-        monkeypatch.delenv("CPZK_DEVICE_CHALLENGES")
-        assert [s.value for s in got] == [s.value for s in expected]
+        ctx = None if contexts[0] is None else as_cols(contexts)
+        chal = derive_challenges_device(ctx, *(as_cols(c) for c in cols))
+        got = [sc_from_bytes_mod_order_wide(chal[i].tobytes()) for i in range(n)]
+        assert got == [s.value for s in expected]
