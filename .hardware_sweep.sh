@@ -61,8 +61,10 @@ while :; do
     # serving-side fixes land; artifact name versioned so the original
     # evidence survives)
     has_metric .hw/e2e_curve_tpu_v2.json '"backend": "tpu"' || {
-      timeout 1800 python benches/bench_e2e_curve.py --ns 4096 \
-        --backend tpu > .hw/e2e_curve_tpu_v2.json 2>> .hw/sweep.log
+      CPZK_BATCH_DEBUG=1 timeout 1800 python benches/bench_e2e_curve.py \
+        --ns 4096 --backend tpu > .hw/e2e_curve_tpu_v2.json \
+        2> .hw/e2e_curve_tpu_v2.err
+      tail -40 .hw/e2e_curve_tpu_v2.err >> .hw/sweep.log
       log "e2e_curve_tpu_v2: $(cat .hw/e2e_curve_tpu_v2.json | tr '\n' ' ')"; }
     probe || { log "wedged after e2e_curve_v2"; continue; }
     # 4. xprof trace (have one from rev1; re-check in case it was lost)

@@ -25,6 +25,7 @@ matches the reference bit-for-bit (SURVEY.md §3.2).
 from __future__ import annotations
 
 import os
+import time
 from functools import partial
 
 import numpy as np
@@ -390,6 +391,8 @@ class TpuBackend(VerifierBackend):
 
         # correction row: G in slot r1 with -sum(a s), H in slot y1 with
         # -b sum(a s); identity in the other two slots.
+        debug = os.environ.get("CPZK_BATCH_DEBUG") == "1"
+        t0 = time.perf_counter() if debug else 0.0
         pad = _pad_lanes(n + 1)
         r1 = _elems_soa([r.r1 for r in rows] + [rows[0].g], pad)
         y1 = _elems_soa([r.y1 for r in rows] + [rows[0].h], pad)
@@ -411,8 +414,18 @@ class TpuBackend(VerifierBackend):
             w_ba = _windows(ba, pad)
             w_bac = _windows(bac, pad)
 
-        return chunked_combined_identity(
+        if not debug:
+            return chunked_combined_identity(
+                pad, r1, y1, r2, y2, w_a, w_ac, w_ba, w_bac)
+        t1 = time.perf_counter()
+        ok = chunked_combined_identity(
             pad, r1, y1, r2, y2, w_a, w_ac, w_ba, w_bac)
+        import sys
+
+        print(f"[backend-debug] n={n} pad={pad} marshal={t1 - t0:.3f}s "
+              f"device={time.perf_counter() - t1:.3f}s",
+              file=sys.stderr, flush=True)
+        return ok
 
     def _combined_pippenger(
         self, rows: list[BatchRow], beta: Scalar, device_rlc: bool

@@ -23,6 +23,7 @@ from __future__ import annotations
 import asyncio
 import logging
 import os
+import sys
 import time
 
 from ..core.rng import SecureRng
@@ -238,4 +239,17 @@ class DynamicBatcher:
             with jax.profiler.trace(xprof):
                 with jax.profiler.TraceAnnotation("cpzk_batch_verify"):
                     return bv.verify(self._rng)
+        if os.environ.get("CPZK_BATCH_DEBUG") == "1":
+            # stage decomposition for the gRPC-on-device collapse
+            # investigation (PROFILE.md §7c): per-batch wall split between
+            # BatchVerifier host prep (challenge derivation, alpha draws)
+            # and the backend call, printed from the worker thread
+            import time as _t
+
+            t0 = _t.perf_counter()
+            out = bv.verify(self._rng)
+            print(f"[batch-debug] n={len(entries)} "
+                  f"verify={_t.perf_counter() - t0:.3f}s",
+                  file=sys.stderr, flush=True)
+            return out
         return bv.verify(self._rng)
