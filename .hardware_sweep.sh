@@ -29,6 +29,7 @@ all_done() {
   has_tpu_bench .hw/bench_64k.json \
     && has_tpu_bench .hw/bench_16k_v2.json \
     && has_metric .hw/e2e_curve_tpu_v2.json '"backend": "tpu"' \
+    && has_tpu_bench .hw/pallas_4k.json \
     && has_tpu_bench .hw/win_13.json \
     && has_trace
 }
@@ -73,6 +74,25 @@ while :; do
       timeout 1200 python benches/capture_xprof.py --n 4096 \
         --kernel rowcombined --outdir .hw/xprof >> .hw/sweep.log 2>&1
       if has_trace; then log "xprof captured"; else log "xprof FAILED"; fi; }
+    # 4b. pallas graduation A/B: in-kernel-asserted rowcombined with the
+    # pallas point kernels, 4k (direct A/B vs the 24.7k XLA number) and
+    # 64k (does explicit tiling sidestep the large-lane miscompile?)
+    has_tpu_bench .hw/pallas_4k.json || {
+      CPZK_PALLAS=1 CPZK_BENCH_N=4096 CPZK_BENCH_KERNEL=rowcombined \
+      CPZK_BENCH_ITERS=3 CPZK_BENCH_DEADLINE_SECS=0 \
+        timeout 1500 python bench.py > .hw/pallas_4k.json 2>> .hw/sweep.log
+      log "pallas_4k: $(cat .hw/pallas_4k.json)"; }
+    probe || { log "wedged after pallas_4k"; continue; }
+    [ -e .hw/pallas_64k_mono.done ] || {
+      CPZK_PALLAS=1 CPZK_LANE_CHUNK=1048576 CPZK_BENCH_N=65536 \
+      CPZK_BENCH_KERNEL=rowcombined CPZK_BENCH_ITERS=3 \
+      CPZK_BENCH_DEADLINE_SECS=0 \
+        timeout 1800 python bench.py > .hw/pallas_64k_mono.json \
+        2>> .hw/sweep.log
+      # one attempt only (informative probe): an assert failure here just
+      # means pallas does not sidestep the large-lane defect
+      probe && touch .hw/pallas_64k_mono.done
+      log "pallas_64k_mono: $(cat .hw/pallas_64k_mono.json)"; }
     probe || { log "wedged before window sweep"; continue; }
     # 5. pippenger window sweep at 16k (mesh-path calibration only now);
     # chunked dispatch should let these PASS where rev1 failed
