@@ -74,6 +74,12 @@ while :; do
       timeout 1200 python benches/capture_xprof.py --n 4096 \
         --kernel rowcombined --outdir .hw/xprof >> .hw/sweep.log 2>&1
       if has_trace; then log "xprof captured"; else log "xprof FAILED"; fi; }
+    # 4a. thread-dispatch latency probe (serving-collapse suspect): 2 min
+    has_metric .hw/threadlat.json threadlat || {
+      timeout 600 python benches/debug_pip16k.py --stage threadlat \
+        > .hw/threadlat.json 2>> .hw/sweep.log
+      log "threadlat: $(cat .hw/threadlat.json)"; }
+    probe || { log "wedged after threadlat"; continue; }
     # 4b. pallas graduation A/B: in-kernel-asserted rowcombined with the
     # pallas point kernels, 4k (direct A/B vs the 24.7k XLA number) and
     # 64k (does explicit tiling sidestep the large-lane miscompile?)

@@ -44,11 +44,21 @@ import jax.numpy as jnp
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from cpzk_tpu.core import _native
-from cpzk_tpu.core import edwards as he
-from cpzk_tpu.core import scalars as hs
-from cpzk_tpu.ops import backend, curve, msm
-from cpzk_tpu.ops import sclimbs as sc
+# cpzk_tpu.ops modules build jax arrays at import time, which initializes
+# the backend — on a wedged axon tunnel that HANGS before --platform can
+# apply.  Import lazily in _load(), called after the platform pin.
+_native = he = hs = backend = curve = msm = sc = None
+
+
+def _load() -> None:
+    global _native, he, hs, backend, curve, msm, sc
+    from cpzk_tpu.core import _native as _n
+    from cpzk_tpu.core import edwards as _he
+    from cpzk_tpu.core import scalars as _hs
+    from cpzk_tpu.ops import backend as _b, curve as _c, msm as _m
+    from cpzk_tpu.ops import sclimbs as _sc
+
+    _native, he, hs, backend, curve, msm, sc = _n, _he, _hs, _b, _c, _m, _sc
 
 
 def emit(**kw) -> None:
@@ -186,12 +196,42 @@ def stage_sum(m: int) -> bool:
     return bool(ok)
 
 
+def stage_threadlat() -> bool:
+    """Main-thread vs worker-thread dispatch latency for the same cached
+    executable (PROFILE.md §7c: the serving batcher verifies on a worker
+    thread via asyncio.to_thread; the fast direct path runs on the main
+    thread — a thread-dependent per-call penalty on the axon tunnel
+    would explain the gRPC-on-device collapse).  Two sizes: tiny (pure
+    dispatch) and ~5 MB (includes transfer)."""
+    import concurrent.futures
+
+    rec = {"stage": "threadlat", "platform": jax.devices()[0].platform}
+    for label, shape in (("tiny", (1024,)), ("5mb", (1310720,))):
+        f = jax.jit(lambda x: x + 1)
+        x = jnp.zeros(shape, dtype=jnp.float32)
+        jax.block_until_ready(f(x))
+
+        def call():
+            t0 = time.monotonic()
+            jax.block_until_ready(f(x))
+            return time.monotonic() - t0
+
+        main = sorted(call() for _ in range(20))
+        with concurrent.futures.ThreadPoolExecutor(1) as ex:
+            worker = sorted(ex.submit(call).result() for _ in range(20))
+        rec[f"{label}_main_med_ms"] = round(main[10] * 1e3, 2)
+        rec[f"{label}_worker_med_ms"] = round(worker[10] * 1e3, 2)
+    emit(**rec)
+    return True
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--m", type=int, default=65538)
     ap.add_argument("--c", type=int, default=13)
     ap.add_argument("--stage",
-                    choices=["digits", "msm", "addlanes", "sum", "all"],
+                    choices=["digits", "msm", "addlanes", "sum", "threadlat",
+                             "all"],
                     default="all")
     ap.add_argument("--platform", default=None,
                     help="force a jax backend (e.g. cpu); needed because "
@@ -200,6 +240,7 @@ def main() -> None:
     args = ap.parse_args()
     if args.platform:
         jax.config.update("jax_platforms", args.platform)
+    _load()
     ok = True
     if args.stage in ("digits", "all"):
         ok &= stage_digits(args.m, args.c)
@@ -207,6 +248,8 @@ def main() -> None:
         ok &= stage_msm(args.m, args.c)
     if args.stage in ("addlanes", "all"):
         ok &= stage_addlanes(args.m)
+    if args.stage in ("threadlat", "all"):
+        ok &= stage_threadlat()
     if args.stage in ("sum", "all"):
         # NOTE: hangs >25 min at m=65536 on TPU v5 lite (the large-lane
         # monolith pathology under investigation) — run last so the
