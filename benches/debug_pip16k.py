@@ -240,7 +240,10 @@ def main() -> None:
     args = ap.parse_args()
     if args.platform:
         jax.config.update("jax_platforms", args.platform)
-    _load()
+    if args.stage != "threadlat":
+        # threadlat uses bare jax only; skipping the ops-stack import
+        # keeps the probe cheap and avoids import-time device touches
+        _load()
     ok = True
     if args.stage in ("digits", "all"):
         ok &= stage_digits(args.m, args.c)

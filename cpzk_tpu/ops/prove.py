@@ -32,7 +32,6 @@ from ..core.scalars import L
 from ..core.transcript import derive_challenges_batch
 from ..protocol.gadgets import PROTOCOL_VERSION, Parameters, frame_fields
 from . import curve
-from .backend import _pad_pow2
 from .curve import NWINDOWS, Point, build_table, table_gather
 
 
@@ -105,12 +104,26 @@ class BatchProver:
                 self._sharded = make_sharded_prove(batch_mesh(devices))
 
     def _fixed_base_bytes(self, scalars: list[int]) -> tuple[np.ndarray, np.ndarray]:
-        """(P1, P2) wire bytes for (k·G, k·H) per scalar, pow2-padded jit."""
+        """(P1, P2) wire bytes for (k·G, k·H) per scalar.
+
+        Proofs are lane-independent, so batches past the device's proven
+        program size run as LANE_CHUNK-lane tiles and the wire-byte
+        columns concatenate (same >33k-lane XLA miscompile workaround as
+        the verifier dispatch, ``ops/backend.py``)."""
+        from .backend import LANE_CHUNK, _chunk_bounds, _pad_lanes
+
         n = len(scalars)
-        pad = _pad_pow2(n)
+        pad = _pad_lanes(n)
         digits = _windows_lsb(scalars + [0] * (pad - n))
         if self._sharded is not None:
             b1, b2 = self._sharded(self._tg, self._th, digits)
+        elif pad > LANE_CHUNK:
+            parts = [
+                _commitments_kernel(self._tg, self._th, digits[:, lo:hi])
+                for lo, hi in _chunk_bounds(pad)
+            ]
+            b1 = jnp.concatenate([p[0] for p in parts], axis=-1)
+            b2 = jnp.concatenate([p[1] for p in parts], axis=-1)
         else:
             b1, b2 = _commitments_kernel(self._tg, self._th, digits)
         return (

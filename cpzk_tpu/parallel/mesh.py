@@ -80,6 +80,19 @@ def pad_windows(w: jnp.ndarray, n_to: int) -> jnp.ndarray:
     )
 
 
+def _mesh_step(d: int, n: int) -> tuple[int, int]:
+    """(step, n_to): the per-slice lane count d*LANE_CHUNK that keeps every
+    per-device program at or under the TPU large-lane miscompile bound
+    (ops/backend.py LANE_CHUNK), and the padded total — a d-multiple below
+    one step, a step-multiple above.  Single source for all three sharded
+    wrappers."""
+    from ..ops import backend as _backend  # lazy: no import cycle
+
+    step = d * _backend.LANE_CHUNK
+    n_to = -(-n // d) * d if n <= step else -(-n // step) * step
+    return step, n_to
+
+
 def _point_specs(spec):
     return (spec, spec, spec, spec)
 
@@ -120,14 +133,8 @@ def make_sharded_verify_each(mesh: Mesh):
     d = mesh.devices.size
 
     def call(g, h, y1, y2, r1, r2, ws, wc):
-        from ..ops import backend as _backend  # lazy: no import cycle
-
         n = ws.shape[-1]
-        # keep every per-device program at or under LANE_CHUNK lanes (the
-        # TPU large-lane miscompile bound, ops/backend.py) by feeding the
-        # mesh in slices of d * LANE_CHUNK rows when needed
-        step = d * _backend.LANE_CHUNK
-        n_to = -(-n // d) * d if n <= step else -(-n // step) * step
+        step, n_to = _mesh_step(d, n)
         y1, y2, r1, r2 = (pad_to_multiple(p, n_to) for p in (y1, y2, r1, r2))
         ws, wc = pad_windows(ws, n_to), pad_windows(wc, n_to)
         if n_to <= step:
@@ -176,8 +183,16 @@ def make_sharded_prove(mesh: Mesh):
 
     def call(tg, th, digits):
         n = digits.shape[-1]
-        n_to = -(-n // d) * d
-        b1, b2 = fn(tg, th, pad_windows(digits, n_to))
+        # proofs are independent, so over-cap batches run as mesh slices
+        step, n_to = _mesh_step(d, n)
+        digits = pad_windows(digits, n_to)
+        if n_to <= step:
+            b1, b2 = fn(tg, th, digits)
+            return b1[:, :n], b2[:, :n]
+        parts = [fn(tg, th, digits[:, lo:lo + step])
+                 for lo in range(0, n_to, step)]
+        b1 = jnp.concatenate([p[0] for p in parts], axis=-1)
+        b2 = jnp.concatenate([p[1] for p in parts], axis=-1)
         return b1[:, :n], b2[:, :n]
 
     return call
@@ -288,12 +303,9 @@ def make_sharded_msm_check(mesh: Mesh):
         from ..ops import backend as _backend  # lazy: no import cycle
 
         m = digits.shape[-1]
-        # cap per-device lanes at LANE_CHUNK (the TPU large-lane
-        # miscompile bound, ops/backend.py): over-cap MSMs run as slices
-        # of d * LANE_CHUNK terms whose [20, D] partials concatenate into
-        # one final tree-sum + identity test
-        step = d * _backend.LANE_CHUNK
-        m_to = -(-m // d) * d if m <= step else -(-m // step) * step
+        # over-cap MSMs run as mesh slices whose [20, D] partials
+        # concatenate into one final tree-sum + identity test
+        step, m_to = _mesh_step(d, m)
         points = pad_to_multiple(points, m_to)
         digits = pad_windows(digits, m_to)
         if c not in cache:
