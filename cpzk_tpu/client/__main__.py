@@ -36,7 +36,22 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument(
         "-s", "--server", default=os.environ.get("AUTH_SERVER", "127.0.0.1:50051")
     )
+    p.add_argument(
+        "--no-retry", action="store_true",
+        help="disable transient-error retries (backoff + budget; "
+             "idempotent-safe RPCs only — logins are never retried)",
+    )
     return p.parse_args(argv)
+
+
+def build_retry_policy(args):
+    """Retry policy from the resolved [retry] config (SERVER_RETRY_* env /
+    server.toml) unless --no-retry; None = straight-through calls."""
+    if args.no_retry:
+        return None
+    from ..server.config import ServerConfig
+
+    return ServerConfig.from_env().retry.build_policy()
 
 
 async def do_register(client: AuthClient, user: str, password: str) -> str:
@@ -204,7 +219,7 @@ async def handle_line(line: str, client: AuthClient, server_addr: str) -> tuple[
 
 
 async def amain(args) -> None:
-    async with AuthClient(args.server) as client:
+    async with AuthClient(args.server, retry=build_retry_policy(args)) as client:
         print(_c("cyan", f"Connected to {args.server}. Type /help for commands."))
         while True:
             try:

@@ -3,20 +3,47 @@
 Mirrors the RPC surface the reference client drives through its generated
 ``AuthServiceClient`` (``src/bin/client.rs``); method paths and message
 types come straight from ``proto/auth.proto``.
+
+Resilience: pass a :class:`~cpzk_tpu.resilience.retry.RetryPolicy` to get
+exponential backoff with full jitter and a shared retry budget on
+transient failures (``UNAVAILABLE``, ``RESOURCE_EXHAUSTED``).  Only
+idempotent-safe RPCs are ever retried — ``VerifyProof`` /
+``VerifyProofBatch`` are excluded because the server consumes their
+challenges on FIRST receipt (even on failure): a resend can never
+succeed, it just burns the challenge, so those errors surface
+immediately and the caller restarts from ``CreateChallenge``.
 """
 
 from __future__ import annotations
 
+import asyncio
+import random
+
 import grpc
 
+from ..resilience.retry import RetryPolicy
 from ..server.proto import SERVICE_NAME, load_pb2, method_types
+
+#: RPCs safe to resend on a transient failure.  Register re-sent after an
+#: unreported success fails loudly with ALREADY_EXISTS (never silently
+#: corrupts); CreateChallenge just mints a fresh nonce; health is pure.
+_RETRY_SAFE = frozenset({"Register", "RegisterBatch", "CreateChallenge", "HealthCheck"})
 
 
 class AuthClient:
     """Thin unary-unary stub set over a grpc.aio channel."""
 
-    def __init__(self, target: str, credentials: grpc.ChannelCredentials | None = None):
+    def __init__(
+        self,
+        target: str,
+        credentials: grpc.ChannelCredentials | None = None,
+        retry: RetryPolicy | None = None,
+        retry_rng: random.Random | None = None,
+    ):
         self.pb2 = load_pb2()
+        self.retry = retry
+        # injectable RNG so chaos tests get deterministic jitter
+        self._retry_rng = retry_rng or random.Random()
         if credentials is not None:
             self.channel = grpc.aio.secure_channel(target, credentials)
         else:
@@ -40,48 +67,88 @@ class AuthClient:
     async def __aexit__(self, *exc) -> None:
         await self.close()
 
+    # --- retry plumbing ---
+
+    async def _call(self, name: str, stub, request, timeout: float | None):
+        """One RPC through the retry policy.  Non-idempotent methods (and
+        clients with no policy) go straight through; the rest retry only
+        on the policy's transient codes, sleeping full-jitter backoff,
+        until attempts or the shared budget run out."""
+        policy = self.retry
+        if policy is None or name not in _RETRY_SAFE:
+            return await stub(request, timeout=timeout)
+        attempt = 0
+        while True:
+            attempt += 1
+            try:
+                response = await stub(request, timeout=timeout)
+            except grpc.RpcError as e:
+                code = e.code()
+                code_name = code.name if code is not None else ""
+                if not policy.should_retry(code_name, attempt):
+                    raise
+                await asyncio.sleep(policy.backoff_s(attempt, self._retry_rng))
+                continue
+            policy.note_success()
+            return response
+
     # --- RPCs ---
 
     async def register(self, user_id: str, y1: bytes, y2: bytes, timeout: float | None = None):
-        return await self._stubs["Register"](
-            self.pb2.RegistrationRequest(user_id=user_id, y1=y1, y2=y2), timeout=timeout
+        return await self._call(
+            "Register",
+            self._stubs["Register"],
+            self.pb2.RegistrationRequest(user_id=user_id, y1=y1, y2=y2),
+            timeout,
         )
 
     async def register_batch(
         self, user_ids: list[str], y1_values: list[bytes], y2_values: list[bytes],
         timeout: float | None = None,
     ):
-        return await self._stubs["RegisterBatch"](
+        return await self._call(
+            "RegisterBatch",
+            self._stubs["RegisterBatch"],
             self.pb2.BatchRegistrationRequest(
                 user_ids=user_ids, y1_values=y1_values, y2_values=y2_values
             ),
-            timeout=timeout,
+            timeout,
         )
 
     async def create_challenge(self, user_id: str, timeout: float | None = None):
-        return await self._stubs["CreateChallenge"](
-            self.pb2.ChallengeRequest(user_id=user_id), timeout=timeout
+        return await self._call(
+            "CreateChallenge",
+            self._stubs["CreateChallenge"],
+            self.pb2.ChallengeRequest(user_id=user_id),
+            timeout,
         )
 
     async def verify_proof(
         self, user_id: str, challenge_id: bytes, proof: bytes, timeout: float | None = None
     ):
-        return await self._stubs["VerifyProof"](
+        # never retried: the challenge is consumed server-side on first
+        # receipt, so a resend is guaranteed PERMISSION_DENIED
+        return await self._call(
+            "VerifyProof",
+            self._stubs["VerifyProof"],
             self.pb2.VerificationRequest(
                 user_id=user_id, challenge_id=challenge_id, proof=proof
             ),
-            timeout=timeout,
+            timeout,
         )
 
     async def verify_proof_batch(
         self, user_ids: list[str], challenge_ids: list[bytes], proofs: list[bytes],
         timeout: float | None = None,
     ):
-        return await self._stubs["VerifyProofBatch"](
+        # never retried (same consumed-challenge semantics as VerifyProof)
+        return await self._call(
+            "VerifyProofBatch",
+            self._stubs["VerifyProofBatch"],
             self.pb2.BatchVerificationRequest(
                 user_ids=user_ids, challenge_ids=challenge_ids, proofs=proofs
             ),
-            timeout=timeout,
+            timeout,
         )
 
     async def health_check(self, timeout: float | None = None):
@@ -93,4 +160,6 @@ class AuthClient:
             request_serializer=pb2.HealthCheckRequest.SerializeToString,
             response_deserializer=pb2.HealthCheckResponse.FromString,
         )
-        return await stub(pb2.HealthCheckRequest(service=""), timeout=timeout)
+        return await self._call(
+            "HealthCheck", stub, pb2.HealthCheckRequest(service=""), timeout
+        )

@@ -48,6 +48,11 @@ class BatchEntry:
     statement: Statement
     proof: Proof
     transcript_context: bytes | None
+    #: absolute ``time.monotonic()`` point after which nobody is waiting for
+    #: this entry's result (the RPC deadline, threaded through the serving
+    #: layer); ``None`` = wait forever.  The dynamic batcher sheds expired
+    #: entries before device dispatch instead of verifying them.
+    deadline: float | None = None
 
 
 @dataclass
@@ -180,23 +185,59 @@ class CpuBackend(VerifierBackend):
 
 
 class FailoverBackend(VerifierBackend):
-    """TPU→CPU failover wrapper (SURVEY.md §5 failure detection).
+    """Self-healing TPU→CPU failover wrapper (SURVEY.md §5 failure
+    detection + resilience subsystem circuit breaker).
 
-    Routes to ``primary`` until it raises, then degrades permanently (for
-    this instance) to ``fallback`` — a failed combined check simply reports
-    False so the dispatcher's per-proof path decides, keeping accept/reject
-    semantics byte-identical through a mid-batch backend loss.  The
-    ``tpu.backend.failover`` counter records degradations; ``reset()``
-    re-arms the primary (e.g. after an operator fixed the device).
+    Routes to ``primary`` until it raises, then degrades to ``fallback``
+    — a failed combined check simply reports False so the dispatcher's
+    per-proof path decides, keeping accept/reject semantics byte-identical
+    through a mid-batch backend loss.  Unlike the old one-way latch,
+    degradation heals: after ``recovery_after_s`` the breaker grants a
+    single *probe* — one batch is verified on BOTH planes, the fallback
+    result stays authoritative, and the primary is re-armed only when its
+    answers match ground truth exactly (a device that comes back *wrong*
+    never regains traffic).  ``recovery_after_s=None`` restores the
+    permanent-until-``reset()`` behavior.
+
+    Observability: ``tpu.backend.failover`` counts CLOSED→OPEN trips,
+    ``tpu.backend.state`` gauges the breaker (0 closed / 1 open / 2
+    half-open), ``tpu.backend.degraded_seconds`` accumulates CPU-only
+    wall time, and each transition logs WARNING exactly once.
     """
 
-    def __init__(self, primary: VerifierBackend, fallback: VerifierBackend):
-        import threading
+    def __init__(
+        self,
+        primary: VerifierBackend,
+        fallback: VerifierBackend,
+        recovery_after_s: float | None = 30.0,
+        probe_batch_max: int = 64,
+        clock=None,
+    ):
+        import time as _time
 
+        from ..resilience.breaker import BreakerState, CircuitBreaker
+
+        if probe_batch_max < 1:
+            raise InvalidParams("probe_batch_max must be positive")
         self.primary = primary
         self.fallback = fallback
-        self.degraded = False
-        self._degrade_lock = threading.Lock()
+        self.probe_batch_max = probe_batch_max
+        self._closed = BreakerState.CLOSED
+        self.breaker = CircuitBreaker(
+            recovery_after_s=recovery_after_s,
+            clock=clock or _time.monotonic,
+            on_transition=self._on_transition,
+        )
+
+    @property
+    def degraded(self) -> bool:
+        """True while traffic is (at least partly) on the fallback."""
+        return self.breaker.state is not self._closed
+
+    @property
+    def state(self):
+        """Breaker state, for the admin REPL ``/status`` line."""
+        return self.breaker.state
 
     @property
     def prefers_combined(self) -> bool:  # type: ignore[override]
@@ -204,34 +245,83 @@ class FailoverBackend(VerifierBackend):
         return backend.prefers_combined
 
     def reset(self) -> None:
-        self.degraded = False
+        """Operator re-arm (bypasses the probe — trust the fix)."""
+        self.breaker.reset()
 
-    def _note_failure(self, exc: Exception) -> None:
+    # -- transitions / observability --------------------------------------
+
+    def _on_transition(self, old, new) -> None:
         import logging
 
-        # pipelined dispatches call backends from multiple threads; only
-        # the first failure logs/counts, and degradation is permanent
-        # until reset()
-        with self._degrade_lock:
-            if self.degraded:
-                return
-            self.degraded = True
-        logging.getLogger("cpzk_tpu.protocol.batch").exception(
-            "primary verifier backend failed; degrading to fallback: %s", exc
-        )
+        from ..resilience.breaker import BreakerState
+
+        log = logging.getLogger("cpzk_tpu.protocol.batch")
+        if new is BreakerState.OPEN and old is BreakerState.CLOSED:
+            log.warning(
+                "primary verifier backend failed; degrading to fallback "
+                "(probe retry in %ss)", self.breaker.recovery_after_s,
+            )
+        elif new is BreakerState.OPEN:
+            log.warning(
+                "primary verifier probe failed or disagreed with fallback "
+                "ground truth; staying degraded (next probe in %ss)",
+                self.breaker.recovery_after_s,
+            )
+        elif new is BreakerState.HALF_OPEN:
+            log.info("probing primary verifier backend with one batch")
+        else:  # -> CLOSED
+            log.warning(
+                "primary verifier backend recovered after %.1fs degraded; "
+                "traffic back on primary", self.breaker.degraded_seconds,
+            )
         try:  # metrics live in the server layer; optional here
             from ..server import metrics
 
-            metrics.counter("tpu.backend.failover").inc()
+            if new is BreakerState.OPEN and old is BreakerState.CLOSED:
+                metrics.counter("tpu.backend.failover").inc()
+            metrics.gauge("tpu.backend.state").set(
+                {"closed": 0, "open": 1, "half-open": 2}[new.value]
+            )
         except Exception:
             pass
 
+    def _touch_degraded_gauge(self) -> None:
+        try:
+            from ..server import metrics
+
+            metrics.gauge("tpu.backend.degraded_seconds").set(
+                self.breaker.degraded_seconds
+            )
+        except Exception:
+            pass
+
+    def _note_failure(self, exc: Exception) -> None:
+        # pipelined dispatches call backends from multiple threads; the
+        # breaker hands the CLOSED->OPEN transition to exactly one of them
+        # (transition logging/metrics live in _on_transition; the device
+        # exception itself is only worth one traceback, not one per batch)
+        if self.breaker.record_failure():
+            import logging
+
+            logging.getLogger("cpzk_tpu.protocol.batch").warning(
+                "primary verifier backend raised", exc_info=exc
+            )
+
+    # -- verification routing ----------------------------------------------
+
     def verify_combined(self, rows: list[BatchRow], beta: Scalar) -> bool:
-        if not self.degraded:
+        self._touch_degraded_gauge()
+        route = self.breaker.acquire()
+        if route == "primary":
             try:
                 return self.primary.verify_combined(rows, beta)
             except Exception as exc:
                 self._note_failure(exc)
+        elif route == "probe":
+            # a combined check has no per-row ground truth to compare the
+            # probe against; hand the token back so the dispatcher's
+            # verify_each pass (or the next batch) runs the real probe
+            self.breaker.release_probe()
         # a False combined check routes the dispatcher to verify_each,
         # which is the ground-truth path on the fallback backend
         if self.fallback.prefers_combined:
@@ -239,12 +329,44 @@ class FailoverBackend(VerifierBackend):
         return False
 
     def verify_each(self, rows: list[BatchRow]) -> list[int]:
-        if not self.degraded:
+        self._touch_degraded_gauge()
+        route = self.breaker.acquire()
+        if route == "primary":
             try:
                 return self.primary.verify_each(rows)
             except Exception as exc:
                 self._note_failure(exc)
+            return self.fallback.verify_each(rows)
+        if route == "probe":
+            return self._probe_each(rows)
         return self.fallback.verify_each(rows)
+
+    def _probe_each(self, rows: list[BatchRow]) -> list[int]:
+        """Half-open probe: fallback verifies the whole batch (its result
+        is returned — authoritative no matter what the primary says); the
+        primary re-verifies the first ``probe_batch_max`` rows and must
+        reproduce ground truth exactly to re-close the breaker."""
+        import logging
+
+        truth = self.fallback.verify_each(rows)
+        n = min(len(rows), self.probe_batch_max)
+        if n == 0:
+            self.breaker.release_probe()
+            return truth
+        try:
+            probe = self.primary.verify_each(rows[:n])
+            agreed = [int(v) for v in probe] == [int(v) for v in truth[:n]]
+        except Exception as exc:
+            logging.getLogger("cpzk_tpu.protocol.batch").warning(
+                "primary verifier probe raised: %s", exc
+            )
+            agreed = False
+        if agreed:
+            self.breaker.probe_succeeded()
+        else:
+            self.breaker.probe_failed()
+        self._touch_degraded_gauge()
+        return truth
 
 
 _DEFAULT_BACKEND: VerifierBackend | None = None

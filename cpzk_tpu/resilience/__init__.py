@@ -1,0 +1,44 @@
+"""Resilience subsystem: self-healing failover, retries, fault injection.
+
+Three pieces, each independently usable:
+
+- :mod:`.breaker` — a thread-safe circuit breaker with half-open probe
+  recovery.  :class:`~cpzk_tpu.protocol.batch.FailoverBackend` drives it so
+  a TPU device loss degrades to the CPU fallback and then *heals* (probe
+  batch re-validated against the fallback ground truth) instead of staying
+  degraded until an operator runs ``reset()``.
+- :mod:`.retry` — client-side exponential backoff with full jitter and a
+  shared retry budget (gRPC A6-style), used by
+  :class:`~cpzk_tpu.client.AuthClient` for idempotent-safe RPCs only.
+- :mod:`.faults` — a seeded, deterministic :class:`FaultPlan` plus backend
+  and snapshot-I/O injectors so the failure paths above are *exercised* by
+  tests (``tests/test_chaos.py``) rather than assumed.
+
+``faults`` pulls in :mod:`cpzk_tpu.protocol.batch`, which itself lazily
+constructs breakers — so this package eagerly exports only the
+dependency-free modules and resolves the rest on attribute access.
+"""
+
+from __future__ import annotations
+
+from .breaker import BreakerState, CircuitBreaker
+from .retry import RetryBudget, RetryPolicy
+
+__all__ = [
+    "BreakerState",
+    "CircuitBreaker",
+    "RetryBudget",
+    "RetryPolicy",
+    "FaultPlan",
+    "FaultInjectionBackend",
+    "InjectedFault",
+    "SnapshotFaults",
+]
+
+
+def __getattr__(name: str):
+    if name in ("FaultPlan", "FaultInjectionBackend", "InjectedFault", "SnapshotFaults"):
+        from . import faults
+
+        return getattr(faults, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

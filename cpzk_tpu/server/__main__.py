@@ -19,6 +19,7 @@ import os
 import signal
 import sys
 
+from . import metrics
 from .config import RateLimiter, ServerConfig
 from .state import ServerState
 
@@ -80,15 +81,24 @@ def build_backend(config):
     from .batching import DynamicBatcher
 
     # mesh_devices semantics: 0 = shard over all visible devices (default),
-    # k = first k devices; TpuBackend skips the mesh when only 1 is visible
+    # k = first k devices; TpuBackend skips the mesh when only 1 is visible.
+    # recovery_after_s = -1 disables the breaker's self-healing (degrade
+    # until an operator reset), anything else is the probe cooldown.
     backend = FailoverBackend(
-        TpuBackend(mesh_devices=config.tpu.mesh_devices), CpuBackend()
+        TpuBackend(mesh_devices=config.tpu.mesh_devices),
+        CpuBackend(),
+        recovery_after_s=(
+            None if config.tpu.recovery_after_s == -1
+            else config.tpu.recovery_after_s
+        ),
+        probe_batch_max=config.tpu.probe_batch_max,
     )
     batcher = DynamicBatcher(
         backend,
         max_batch=config.tpu.batch_max,
         window_ms=config.tpu.batch_window_ms,
         pipeline_depth=config.tpu.pipeline_depth,
+        shed_expired=config.tpu.shed_expired,
     )
     return backend, batcher
 
@@ -128,17 +138,22 @@ async def cleanup_supervisor(
 
 
 HELP = """Available commands:
-  /status      (/st)  server status summary
+  /status      (/st)  server status summary (incl. backend breaker state)
   /users       (/u)   registered user count
   /sessions    (/s)   active session count
   /challenges  (/c)   pending challenge count
   /cleanup     (/gc)  run an expiry sweep now
+  /reset       (/rearm) re-arm the TPU failover breaker
   /help        (/h)   this help
   /quit        (/q)   graceful shutdown"""
 
 
-async def handle_command(cmd: str, state: ServerState) -> tuple[str, bool]:
-    """(output, should_quit) for one REPL line (server.rs:50-90,261-359)."""
+async def handle_command(
+    cmd: str, state: ServerState, backend=None
+) -> tuple[str, bool]:
+    """(output, should_quit) for one REPL line (server.rs:50-90,261-359).
+    ``backend`` is the serving FailoverBackend (None on the inline CPU
+    path) — /status surfaces its breaker state, /reset re-arms it."""
     cmd = cmd.strip()
     if not cmd:
         return "", False
@@ -151,7 +166,19 @@ async def handle_command(cmd: str, state: ServerState) -> tuple[str, bool]:
             await state.session_count(),
             await state.challenge_count(),
         )
-        return f"users={u} sessions={s} challenges={c}", False
+        line = f"users={u} sessions={s} challenges={c}"
+        if backend is not None and hasattr(backend, "breaker"):
+            line += (
+                f" backend={backend.breaker.state.value}"
+                f" degraded_for={backend.breaker.degraded_seconds:.1f}s"
+                f" expired_shed={int(metrics.read('tpu.queue.expired'))}"
+            )
+        return line, False
+    if word in ("/reset", "/rearm"):
+        if backend is None or not hasattr(backend, "breaker"):
+            return "no failover backend to reset (inline CPU path)", False
+        backend.reset()
+        return "breaker re-armed: traffic back on the primary backend", False
     if word in ("/users", "/u"):
         return f"registered users: {await state.user_count()}", False
     if word in ("/sessions", "/s"):
@@ -258,7 +285,7 @@ async def amain(args) -> None:
             except (EOFError, KeyboardInterrupt):
                 stop.set()
                 return
-            out, quit_ = await handle_command(line, state)
+            out, quit_ = await handle_command(line, state, backend)
             if out:
                 print(_c("white", out))
             if quit_:

@@ -13,9 +13,18 @@ results.  Accept/reject semantics are exactly the BatchVerifier ground
 truth, so batching is observationally identical to inline verification —
 only latency (+window) and throughput change.
 
-Gauges (VERDICT round-1 §metrics): ``tpu.queue.depth``,
-``tpu.batch.fill_ratio``, ``tpu.batch.latency`` (histogram),
-``tpu.batch.proofs`` (counter).
+Deadline shedding (resilience subsystem): each entry may carry the
+absolute monotonic deadline of the RPC that queued it; the dispatcher
+drops already-expired entries *before* device dispatch, resolving their
+futures with :class:`DeadlineExceeded` — a saturated queue stops burning
+device time on answers nobody is waiting for.  ``shed_expired=False``
+restores verify-everything behavior.
+
+Gauges (VERDICT round-1 §metrics): ``tpu.queue.depth`` (queued +
+claimed-by-in-flight-dispatches — cannot go stale at 0 under
+pipelining), ``tpu.batch.fill_ratio``, ``tpu.batch.latency`` (histogram),
+``tpu.batch.proofs`` / ``tpu.queue.shed`` / ``tpu.queue.expired``
+(counters).
 """
 
 from __future__ import annotations
@@ -41,6 +50,13 @@ class QueueFull(Exception):
     grows without limit under sustained overload)."""
 
 
+class DeadlineExceeded(Exception):
+    """Deadline-shed signal: the entry's RPC deadline expired while it was
+    queued, so it was dropped before device dispatch.  The RPC layer maps
+    this to DEADLINE_EXCEEDED (usually moot — the client already gave up —
+    but it keeps the status truthful for proxies and logs)."""
+
+
 class DynamicBatcher:
     """Deadline-based request coalescing in front of a ``VerifierBackend``."""
 
@@ -51,9 +67,11 @@ class DynamicBatcher:
         window_ms: float = 5.0,
         max_queue: int | None = None,
         pipeline_depth: int = 2,
+        shed_expired: bool = True,
     ):
         self.backend = backend
         self.max_batch = max_batch
+        self.shed_expired = shed_expired
         # shed load once more than a few device batches are waiting; the
         # dispatcher drains max_batch per pass, so 4x is ~4 windows of grace
         self.max_queue = max_queue if max_queue is not None else 4 * max_batch
@@ -65,6 +83,11 @@ class DynamicBatcher:
         # strictly serial dispatch.
         self.pipeline_depth = max(1, pipeline_depth)
         self._inflight: asyncio.Semaphore | None = None
+        # entries claimed by in-flight dispatches but not yet resolved;
+        # counted into both backpressure and the depth gauge so pipelining
+        # can't hide a device's worth of queued work (satellite fix: the
+        # gauge used to go stale at 0 the moment the queue drained)
+        self._inflight_entries = 0
         self._dispatches: set[asyncio.Task] = set()
         self._queue: list[tuple[BatchEntry, asyncio.Future]] = []
         self._wakeup: asyncio.Event = asyncio.Event()
@@ -95,9 +118,13 @@ class DynamicBatcher:
         statement: Statement,
         proof: Proof,
         context: bytes | None,
+        deadline: float | None = None,
     ) -> Error | None:
-        """Queue one proof; resolves to ``None`` (ok) or the ``Error``."""
-        entry = BatchEntry(params, statement, proof, context)
+        """Queue one proof; resolves to ``None`` (ok) or the ``Error``.
+        ``deadline`` is an absolute ``time.monotonic()`` point (the RPC
+        deadline); past it the entry is shed instead of verified and the
+        await raises :class:`DeadlineExceeded`."""
+        entry = BatchEntry(params, statement, proof, context, deadline=deadline)
         return (await self.submit_many([entry]))[0]
 
     async def submit_many(
@@ -118,7 +145,11 @@ class DynamicBatcher:
             # shutdown window (stop() ran but the listener is still up) or
             # batcher never started: verify inline with identical semantics
             return await asyncio.to_thread(self._verify, entries)
-        if len(self._queue) + len(entries) > self.max_queue:
+        # backpressure over the whole pipeline: queued entries PLUS entries
+        # already claimed by in-flight dispatches — otherwise a deep
+        # pipeline accepts up to pipeline_depth*max_batch extra work the
+        # instant the queue drains, defeating the cap
+        if len(self._queue) + self._inflight_entries + len(entries) > self.max_queue:
             metrics.counter("tpu.queue.shed").inc()
             raise QueueFull(
                 f"verification queue at capacity ({self.max_queue} entries)"
@@ -126,7 +157,7 @@ class DynamicBatcher:
         loop = asyncio.get_running_loop()
         futs = [loop.create_future() for _ in entries]
         self._queue.extend(zip(entries, futs))
-        metrics.gauge("tpu.queue.depth").set(len(self._queue))
+        self._set_depth_gauge()
         self._wakeup.set()
         # Futures resolve to an Error VALUE for a per-entry verification
         # failure and to a raised exception only for dispatch blowups —
@@ -184,9 +215,15 @@ class DynamicBatcher:
             if self._inflight is None:
                 self._inflight = asyncio.Semaphore(self.pipeline_depth)
             while self._queue:
+                # shed entries whose RPC deadline already passed — nobody
+                # is waiting, so device time on them is pure waste
+                self._drop_expired()
                 take = self._queue[: self.max_batch]
+                if not take:
+                    break
                 del self._queue[: len(take)]
-                metrics.gauge("tpu.queue.depth").set(len(self._queue))
+                self._inflight_entries += len(take)
+                self._set_depth_gauge()
                 # bounded pipeline: block only when pipeline_depth batches
                 # are already in flight; otherwise batch k+1's host prep
                 # overlaps batch k's device compute on another thread
@@ -206,8 +243,67 @@ class DynamicBatcher:
         finally:
             assert self._inflight is not None
             self._inflight.release()
+            # recompute after the drain: the gauge reflects queued +
+            # in-flight work, so it cannot read 0 while a device batch is
+            # still resolving (satellite fix)
+            self._inflight_entries -= len(take)
+            self._set_depth_gauge()
+
+    def _set_depth_gauge(self) -> None:
+        metrics.gauge("tpu.queue.depth").set(
+            len(self._queue) + self._inflight_entries
+        )
+
+    def _split_expired(
+        self, items: list[tuple[BatchEntry, asyncio.Future]]
+    ) -> tuple[list[tuple[BatchEntry, asyncio.Future]], list[asyncio.Future]]:
+        """(live, expired-futures) partition of ``items`` at now.  Entries
+        whose future is already done (RPC cancelled while queued — e.g.
+        the client's deadline fired first) are dropped on the floor here
+        too: nobody can observe their result, so verifying them would be
+        the same waste as verifying an expired entry.  They count into
+        ``tpu.queue.abandoned`` rather than ``tpu.queue.expired`` so the
+        two shed paths stay distinguishable on a dashboard."""
+        if not self.shed_expired:
+            return items, []
+        now = time.monotonic()
+        live, expired, abandoned = [], [], 0
+        for entry, fut in items:
+            if fut.done():
+                abandoned += 1
+            elif entry.deadline is not None and now >= entry.deadline:
+                expired.append(fut)
+            else:
+                live.append((entry, fut))
+        if abandoned:
+            metrics.counter("tpu.queue.abandoned").inc(abandoned)
+        return live, expired
+
+    def _resolve_expired(self, futs: list[asyncio.Future]) -> None:
+        if not futs:
+            return
+        metrics.counter("tpu.queue.expired").inc(len(futs))
+        for fut in futs:
+            fut.set_exception(
+                DeadlineExceeded("RPC deadline expired before dispatch")
+            )
+
+    def _drop_expired(self) -> None:
+        live, expired = self._split_expired(self._queue)
+        if len(live) != len(self._queue):  # expired OR abandoned were cut
+            self._queue[:] = live
+            self._set_depth_gauge()
+        self._resolve_expired(expired)
 
     async def _dispatch(self, take: list[tuple[BatchEntry, asyncio.Future]]) -> None:
+        # entries can also expire between the drain-loop slice and this
+        # dispatch actually running (pipeline backpressure waits on the
+        # in-flight semaphore in between) — shed them here too, right
+        # before device work is committed
+        take, expired = self._split_expired(take)
+        self._resolve_expired(expired)
+        if not take:
+            return
         entries = [e for e, _ in take]
         futs = [f for _, f in take]
         metrics.gauge("tpu.batch.fill_ratio").set(len(entries) / self.max_batch)

@@ -27,15 +27,33 @@ def _sanitize(name: str) -> str:
     return name.replace(".", "_")
 
 
+class _Cell:
+    """Minimal value holder mirroring prometheus_client's ``_value`` API so
+    :func:`read` works identically against either backing."""
+
+    def __init__(self) -> None:
+        self._v = 0.0
+
+    def get(self) -> float:
+        return self._v
+
+
 class _NoopMetric:
-    def inc(self, *_a) -> None:
-        pass
+    """Inert stand-in without prometheus_client: no exposition endpoint,
+    but values are still tracked so :func:`read` (REPL ``/status``, chaos
+    tests) sees real numbers either way."""
+
+    def __init__(self) -> None:
+        self._value = _Cell()
+
+    def inc(self, amount: float = 1.0) -> None:
+        self._value._v += amount
 
     def observe(self, *_a) -> None:
         pass
 
-    def set(self, *_a) -> None:
-        pass
+    def set(self, value: float) -> None:
+        self._value._v = float(value)
 
 
 def counter(name: str):
@@ -70,6 +88,20 @@ def gauge(name: str):
         else:
             _REGISTRY[key] = _NoopMetric()
     return _REGISTRY[key]
+
+
+def read(name: str, kind: str = "c") -> float:
+    """Current value of a counter (``kind="c"``) or gauge (``"g"``) — 0.0
+    when the metric was never touched.  In-process observability seam for
+    the admin REPL and the chaos test suite; Prometheus exposition remains
+    the operator surface."""
+    metric = _REGISTRY.get(f"{kind}:{name}")
+    if metric is None:
+        return 0.0
+    try:
+        return float(metric._value.get())  # type: ignore[union-attr]
+    except AttributeError:  # pragma: no cover - unexpected backing object
+        return 0.0
 
 
 def start_exporter(host: str, port: int) -> bool:

@@ -71,6 +71,19 @@ class AuthServiceImpl:
         if msg is not None:
             await context.abort(grpc.StatusCode.INVALID_ARGUMENT, msg)
 
+    @staticmethod
+    def _rpc_deadline(context) -> float | None:
+        """Absolute ``time.monotonic()`` deadline of this RPC, or None when
+        the client set none.  Threaded into queued ``BatchEntry``s so the
+        dynamic batcher can shed entries nobody is waiting for anymore."""
+        try:
+            remaining = context.time_remaining()
+        except Exception:  # hand-rolled test contexts without deadlines
+            return None
+        if remaining is None:
+            return None
+        return time.monotonic() + max(0.0, remaining)
+
     def _parse_statement(self, y1_bytes: bytes, y2_bytes: bytes) -> Statement:
         """Shared register-path statement validation; raises errors.Error
         with the reference's message prefixes."""
@@ -255,12 +268,20 @@ class AuthServiceImpl:
             # device batch; per-entry result has identical semantics
             try:
                 verify_err = await self.batcher.submit(
-                    Parameters.new(), user.statement, proof, bytes(request.challenge_id)
+                    Parameters.new(), user.statement, proof,
+                    bytes(request.challenge_id),
+                    deadline=self._rpc_deadline(context),
                 )
             except batching.QueueFull:
                 metrics.counter("auth.verify.failure").inc()
                 await context.abort(
                     grpc.StatusCode.RESOURCE_EXHAUSTED, "Server overloaded"
+                )
+            except batching.DeadlineExceeded:
+                metrics.counter("auth.verify.failure").inc()
+                await context.abort(
+                    grpc.StatusCode.DEADLINE_EXCEEDED,
+                    "Deadline expired before verification",
                 )
         else:
             verifier = Verifier(Parameters.new(), user.statement)
@@ -380,7 +401,12 @@ class AuthServiceImpl:
             try:
                 if self.batcher is not None:
                     # one bulk enqueue; all-or-nothing on backpressure, so
-                    # no orphaned sibling submits to drain on QueueFull
+                    # no orphaned sibling submits to drain on QueueFull.
+                    # All entries share this RPC's deadline: past it the
+                    # batcher sheds them instead of burning device time.
+                    deadline = self._rpc_deadline(context)
+                    for entry in batch.entries:
+                        entry.deadline = deadline
                     batch_results = await self.batcher.submit_many(batch.entries)
                 else:
                     # worker thread, not the event loop: the native verify
@@ -395,6 +421,12 @@ class AuthServiceImpl:
                 metrics.counter("auth.verify_batch.failure").inc()
                 await context.abort(
                     grpc.StatusCode.RESOURCE_EXHAUSTED, "Server overloaded"
+                )
+            except batching.DeadlineExceeded:
+                metrics.counter("auth.verify_batch.failure").inc()
+                await context.abort(
+                    grpc.StatusCode.DEADLINE_EXCEEDED,
+                    "Deadline expired before verification",
                 )
             except errors.Error as e:
                 metrics.counter("auth.verify_batch.failure").inc()

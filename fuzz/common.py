@@ -46,6 +46,33 @@ def _mutate(rng: random.Random, data: bytes) -> bytes:
     return bytes(buf)
 
 
+def run_bounded(one_input, seeds: list[bytes], runs: int = 0,
+                seconds: float = 15.0, seed: int = 0) -> int:
+    """Built-in engine, bounded by ``runs`` (when nonzero) or ``seconds``.
+    Deterministic under a fixed ``seed`` apart from the raw-random-blob
+    draws.  Returns the number of executions; raises on the first
+    invariant violation with the reproducing input hex-dumped.  This is
+    the entry point the CI fuzz-smoke tests drive directly (Atheris, when
+    installed, would ignore bounds and fuzz forever)."""
+    rng = random.Random(seed)
+    corpus = list(seeds) + [b"", b"\x01", os.urandom(109)]
+    deadline = time.monotonic() + seconds
+    done = 0
+    while (runs and done < runs) or (not runs and time.monotonic() < deadline):
+        if rng.random() < 0.15:
+            data = os.urandom(rng.randint(0, 160))
+        else:
+            data = _mutate(rng, rng.choice(corpus))
+        try:
+            one_input(data)
+        except Exception:
+            print(f"INVARIANT VIOLATION after {done} runs", file=sys.stderr)
+            print("input:", data.hex(), file=sys.stderr)
+            raise
+        done += 1
+    return done
+
+
 def run_fuzzer(one_input, seeds: list[bytes], argv=None) -> None:
     """Drive ``one_input(data: bytes)``; Atheris when present, else the
     built-in engine.  ``one_input`` must raise only on invariant violations
@@ -65,20 +92,6 @@ def run_fuzzer(one_input, seeds: list[bytes], argv=None) -> None:
     ap.add_argument("--seed", type=int, default=0)
     args = ap.parse_args(argv)
 
-    rng = random.Random(args.seed)
-    corpus = list(seeds) + [b"", b"\x01", os.urandom(109)]
-    deadline = time.monotonic() + args.seconds
-    runs = 0
-    while (args.runs and runs < args.runs) or (not args.runs and time.monotonic() < deadline):
-        if rng.random() < 0.15:
-            data = os.urandom(rng.randint(0, 160))
-        else:
-            data = _mutate(rng, rng.choice(corpus))
-        try:
-            one_input(data)
-        except Exception:
-            print(f"INVARIANT VIOLATION after {runs} runs", file=sys.stderr)
-            print("input:", data.hex(), file=sys.stderr)
-            raise
-        runs += 1
+    runs = run_bounded(one_input, seeds, runs=args.runs,
+                       seconds=args.seconds, seed=args.seed)
     print(f"ok: {runs} runs, no invariant violations")

@@ -1,0 +1,910 @@
+"""Chaos suite: deterministic fault injection through the full serving
+stack (resilience subsystem tentpole).
+
+Every scenario is driven by a seeded :class:`FaultPlan`, so "the TPU dies
+mid-batch", "the device flaps and stabilizes", "a deadline storm hits a
+saturated queue" and "the queue sheds overload" are exact, replayable
+schedules — not sampled timing windows.  The invariants under test:
+
+- accept/reject results are ALWAYS the CPU ground truth, through every
+  failover, probe, and recovery (zero wrong answers);
+- a flapping-then-stable primary ends with the breaker CLOSED (traffic
+  back on the TPU plane) without operator intervention;
+- queue entries whose RPC deadline passed are resolved as
+  DEADLINE_EXCEEDED and never reach the device;
+- gRPC health stays SERVING while degraded (the fallback still answers);
+- the client retry policy retries transient codes for idempotent-safe
+  RPCs only, within its budget, and never resends a consumed challenge.
+"""
+
+import asyncio
+import random
+import threading
+import time
+
+import grpc
+import pytest
+
+from cpzk_tpu import Parameters, Prover, SecureRng, Transcript, Witness
+from cpzk_tpu.client import AuthClient
+from cpzk_tpu.core.ristretto import Ristretto255
+from cpzk_tpu.protocol.batch import (
+    BatchVerifier,
+    CpuBackend,
+    FailoverBackend,
+    VerifierBackend,
+)
+from cpzk_tpu.resilience import RetryBudget, RetryPolicy
+from cpzk_tpu.resilience.breaker import BreakerState, CircuitBreaker
+from cpzk_tpu.resilience.faults import FaultInjectionBackend, FaultPlan
+from cpzk_tpu.server import RateLimiter, ServerState, metrics
+from cpzk_tpu.server.batching import DeadlineExceeded, DynamicBatcher, QueueFull
+from cpzk_tpu.server.service import serve
+
+pytestmark = pytest.mark.chaos
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def make_proofs(n, params=None, rng=None):
+    rng = rng or SecureRng()
+    params = params or Parameters.new()
+    out = []
+    for _ in range(n):
+        prover = Prover(params, Witness(Ristretto255.random_scalar(rng)))
+        proof = prover.prove_with_transcript(rng, Transcript())
+        out.append((prover.statement, proof))
+    return params, out
+
+
+# --- breaker state machine ---------------------------------------------------
+
+
+def test_breaker_state_machine():
+    t = [0.0]
+    br = CircuitBreaker(recovery_after_s=10.0, clock=lambda: t[0])
+    assert br.state is BreakerState.CLOSED
+    assert br.acquire() == "primary"
+
+    assert br.record_failure() is True  # caller that transitioned
+    assert br.record_failure() is False  # concurrent batch: no double-count
+    assert br.state is BreakerState.OPEN
+
+    t[0] = 9.9
+    assert br.acquire() == "fallback"  # cooldown not served
+    t[0] = 10.0
+    assert br.acquire() == "probe"  # exactly one probe granted
+    assert br.state is BreakerState.HALF_OPEN
+    assert br.acquire() == "fallback"  # probe already in flight
+
+    br.probe_failed()
+    assert br.state is BreakerState.OPEN
+    t[0] = 15.0
+    assert br.acquire() == "fallback"  # cooldown restarted at t=10
+    t[0] = 20.0
+    assert br.acquire() == "probe"
+    br.probe_succeeded()
+    assert br.state is BreakerState.CLOSED
+    assert br.acquire() == "primary"
+    assert br.degraded_seconds == pytest.approx(20.0)  # t=0 .. t=20
+
+    # release_probe hands the token back without restarting the cooldown
+    br.record_failure()  # t=20
+    t[0] = 30.0
+    assert br.acquire() == "probe"
+    br.release_probe()
+    assert br.acquire() == "probe"  # immediately re-grantable
+
+    # recovery_after_s=None: the legacy permanent latch
+    t2 = [0.0]
+    br2 = CircuitBreaker(recovery_after_s=None, clock=lambda: t2[0])
+    br2.record_failure()
+    t2[0] = 1e9
+    assert br2.acquire() == "fallback"
+    br2.reset()
+    assert br2.acquire() == "primary"
+
+
+def test_breaker_probe_token_is_exclusive_across_threads():
+    t = [100.0]
+    br = CircuitBreaker(recovery_after_s=0.0, clock=lambda: t[0])
+    br.record_failure()
+    routes = []
+    barrier = threading.Barrier(8)
+
+    def worker():
+        barrier.wait()
+        routes.append(br.acquire())
+
+    threads = [threading.Thread(target=worker) for _ in range(8)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    assert routes.count("probe") == 1
+    assert routes.count("fallback") == 7
+
+
+# --- fault plan determinism --------------------------------------------------
+
+
+def test_fault_plan_is_deterministic():
+    def build():
+        return (
+            FaultPlan(seed=3)
+            .fail_on(2)
+            .fail_range(5, 7)
+            .flap(period=3, fail=1, start=9, until=15)
+        )
+
+    expected = {2, 5, 6, 9, 12}
+    assert {i for i in range(20) if build().should_fail(i)} == expected
+    # identical plans -> identical schedules, run after run
+    a, b = build(), build()
+    assert [a.should_fail(i) for i in range(50)] == [b.should_fail(i) for i in range(50)]
+
+    p1 = FaultPlan(seed=1).fail_probability(0.5, until=200)
+    p2 = FaultPlan(seed=1).fail_probability(0.5, until=200)
+    seq = [p1.should_fail(i) for i in range(200)]
+    assert seq == [p2.should_fail(i) for i in range(200)]
+    assert any(seq) and not all(seq)  # actually probabilistic
+    # different seed -> different draw
+    p3 = FaultPlan(seed=2).fail_probability(0.5, until=200)
+    assert seq != [p3.should_fail(i) for i in range(200)]
+
+    lat = FaultPlan(seed=2).latency(0.1, every=4)
+    assert lat.latency_for(0) > 0 and lat.latency_for(1) == 0.0
+    assert lat.latency_for(0) == FaultPlan(seed=2).latency(0.1, every=4).latency_for(0)
+    assert 0.05 <= lat.latency_for(4) <= 0.15  # ±50% jitter band
+
+    plan = FaultPlan().snapshot_errors(2)
+    assert plan.take_snapshot_error() and plan.take_snapshot_error()
+    assert not plan.take_snapshot_error()
+
+    assert FaultPlan().fail_after(3).should_fail(10**9)
+    with pytest.raises(ValueError):
+        FaultPlan().flap(period=0, fail=0)
+
+
+# --- failover self-healing ---------------------------------------------------
+
+
+def test_failover_self_heals_after_transient_fault():
+    """Fail once, cool down, probe, re-arm: the one-way latch is gone."""
+    params, proofs = make_proofs(4)
+    t = [0.0]
+    fault = FaultInjectionBackend(CpuBackend(), FaultPlan().fail_on(0))
+    backend = FailoverBackend(
+        fault, CpuBackend(), recovery_after_s=5.0, clock=lambda: t[0]
+    )
+    rng = SecureRng()
+
+    def verify_wave():
+        bv = BatchVerifier(backend=backend)
+        for st, pr in proofs:
+            bv.add(params, st, pr)
+        return bv.verify(rng)
+
+    assert verify_wave() == [None] * 4  # batch 0: injected fault -> fallback
+    assert backend.degraded and backend.state is BreakerState.OPEN
+    assert fault.faults_raised == 1
+
+    assert verify_wave() == [None] * 4  # still cooling down: primary untouched
+    assert fault.batches_seen == 1
+
+    t[0] = 5.0
+    assert verify_wave() == [None] * 4  # probe batch: primary agrees
+    assert backend.state is BreakerState.CLOSED and not backend.degraded
+    assert fault.batches_seen == 2
+
+    before = fault.batches_seen
+    assert verify_wave() == [None] * 4  # traffic is back on the primary
+    assert fault.batches_seen == before + 1
+
+
+class LyingBackend(VerifierBackend):
+    """A device that comes back WRONG: accepts every proof."""
+
+    prefers_combined = False
+
+    def verify_combined(self, rows, beta):  # pragma: no cover - unused
+        raise AssertionError("unused")
+
+    def verify_each(self, rows):
+        return [1] * len(rows)
+
+
+def test_probe_disagreement_keeps_fallback_authoritative():
+    """A primary that answers — incorrectly — never re-arms, and its wrong
+    answers are never returned to callers."""
+    params, proofs = make_proofs(3)
+    t = [0.0]
+    lying = FaultInjectionBackend(LyingBackend(), FaultPlan().fail_on(0))
+    backend = FailoverBackend(
+        lying, CpuBackend(), recovery_after_s=1.0, clock=lambda: t[0]
+    )
+    rng = SecureRng()
+
+    def verify_wave():
+        bv = BatchVerifier(backend=backend)
+        bv.add(params, proofs[0][0], proofs[0][1])
+        bv.add(params, proofs[1][0], proofs[1][1])
+        bv.add(params, proofs[0][0], proofs[2][1])  # mismatched -> must reject
+        return bv.verify(rng)
+
+    def assert_truth(results):
+        assert results[0] is None and results[1] is None and results[2] is not None
+
+    assert_truth(verify_wave())  # batch 0 raises -> open
+    assert backend.state is BreakerState.OPEN
+    for round_no in range(3):
+        t[0] += 1.0
+        assert_truth(verify_wave())  # probe: lying primary accepts row 2
+        assert backend.state is BreakerState.OPEN, round_no  # never re-arms
+
+
+def test_probe_respects_probe_batch_max():
+    """The probe re-verifies at most probe_batch_max rows on the primary."""
+    params, proofs = make_proofs(6)
+    t = [0.0]
+
+    class RowCounting(CpuBackend):
+        seen_rows: list = []
+
+        def verify_each(self, rows):
+            self.seen_rows.append(len(rows))
+            return super().verify_each(rows)
+
+    counting = RowCounting()
+    fault = FaultInjectionBackend(counting, FaultPlan().fail_on(0))
+    backend = FailoverBackend(
+        fault, CpuBackend(), recovery_after_s=0.0, probe_batch_max=2,
+        clock=lambda: t[0],
+    )
+    rng = SecureRng()
+    for _ in range(2):  # batch 0 trips, batch 1 probes
+        bv = BatchVerifier(backend=backend)
+        for st, pr in proofs:
+            bv.add(params, st, pr)
+        assert bv.verify(rng) == [None] * 6
+    assert backend.state is BreakerState.CLOSED
+    assert counting.seen_rows == [2]  # the probe slice, nothing more
+
+
+# --- deadline shedding -------------------------------------------------------
+
+
+class RowCountingBackend(CpuBackend):
+    def __init__(self):
+        self.rows_verified = 0
+
+    def verify_each(self, rows):
+        self.rows_verified += len(rows)
+        return super().verify_each(rows)
+
+
+def test_expired_entries_shed_before_dispatch():
+    """Acceptance: expired queue entries resolve as DEADLINE_EXCEEDED and
+    are never verified."""
+    params, proofs = make_proofs(5)
+    backend = RowCountingBackend()
+    expired_before = metrics.read("tpu.queue.expired")
+
+    async def main():
+        batcher = DynamicBatcher(backend, max_batch=64, window_ms=30.0)
+        batcher.start()
+        now = time.monotonic()
+        coros = [
+            batcher.submit(params, st, pr, None, deadline=now + 30.0)
+            for st, pr in proofs[:3]
+        ] + [
+            batcher.submit(params, st, pr, None, deadline=now - 0.001)
+            for st, pr in proofs[3:]
+        ]
+        results = await asyncio.gather(*coros, return_exceptions=True)
+        await batcher.stop()
+        return results
+
+    results = run(main())
+    assert results[:3] == [None] * 3
+    assert all(isinstance(r, DeadlineExceeded) for r in results[3:])
+    assert backend.rows_verified == 3  # zero device rows for expired entries
+    assert metrics.read("tpu.queue.expired") - expired_before == 2
+
+
+def test_shed_expired_toggle_off_verifies_everything():
+    params, proofs = make_proofs(2)
+    backend = RowCountingBackend()
+
+    async def main():
+        batcher = DynamicBatcher(
+            backend, max_batch=64, window_ms=10.0, shed_expired=False
+        )
+        batcher.start()
+        now = time.monotonic()
+        results = await asyncio.gather(
+            *[
+                batcher.submit(params, st, pr, None, deadline=now - 1.0)
+                for st, pr in proofs
+            ]
+        )
+        await batcher.stop()
+        return results
+
+    assert run(main()) == [None, None]  # verified despite expiry
+    assert backend.rows_verified == 2
+
+
+def test_cancelled_entries_dropped_and_counted_once():
+    """RPCs cancelled while queued are dropped at drain time (no device
+    work) and counted into tpu.queue.abandoned exactly once."""
+    params, proofs = make_proofs(4)
+    backend = RowCountingBackend()
+    abandoned_before = metrics.read("tpu.queue.abandoned")
+
+    async def main():
+        batcher = DynamicBatcher(backend, max_batch=64, window_ms=50.0)
+        batcher.start()
+        futs = [
+            asyncio.ensure_future(batcher.submit(params, st, pr, None))
+            for st, pr in proofs
+        ]
+        await asyncio.sleep(0.01)  # everything enqueued, window still open
+        futs[0].cancel()
+        futs[1].cancel()
+        results = await asyncio.gather(*futs, return_exceptions=True)
+        await batcher.stop()
+        return results
+
+    results = run(main())
+    assert all(isinstance(r, asyncio.CancelledError) for r in results[:2])
+    assert results[2:] == [None, None]
+    assert backend.rows_verified == 2  # only the live pair hit the device
+    assert metrics.read("tpu.queue.abandoned") - abandoned_before == 2
+
+
+def test_grpc_threads_rpc_deadline_into_batcher():
+    """The serving layer converts the gRPC deadline into an absolute
+    monotonic deadline on the queued entry."""
+
+    async def main():
+        rng = SecureRng()
+        params = Parameters.new()
+        state = ServerState()
+        batcher = DynamicBatcher(CpuBackend(), max_batch=64, window_ms=5.0)
+        server, port = await serve(
+            state, RateLimiter(10_000, 10_000), port=0, batcher=batcher
+        )
+        captured = []
+        orig_submit = batcher.submit
+
+        async def spy(params_, statement, proof, context, deadline=None):
+            captured.append(deadline)
+            return await orig_submit(
+                params_, statement, proof, context, deadline=deadline
+            )
+
+        batcher.submit = spy
+        try:
+            async with AuthClient(f"127.0.0.1:{port}") as client:
+                cid, pf = await _register_and_prove(client, "dl-user", rng, params)
+                t0 = time.monotonic()
+                resp = await client.verify_proof("dl-user", cid, pf, timeout=30.0)
+                assert resp.success
+
+                cid2, pf2 = await _register_and_prove(client, "dl-user2", rng, params)
+                resp = await client.verify_proof("dl-user2", cid2, pf2)  # no deadline
+                assert resp.success
+        finally:
+            await batcher.stop()
+            await server.stop(None)
+        assert len(captured) == 2
+        assert captured[0] is not None
+        assert 0.0 < captured[0] - t0 <= 30.5  # absolute monotonic deadline
+        assert captured[1] is None
+
+    run(main())
+
+
+def test_grpc_deadline_storm_never_reaches_device():
+    """Client deadlines fire while entries sit in a slow queue: the drain
+    drops every one of them (cancelled or expired) without device work,
+    and the server stays healthy for the next caller."""
+
+    async def main():
+        rng = SecureRng()
+        params = Parameters.new()
+        state = ServerState()
+        backend = RowCountingBackend()
+        batcher = DynamicBatcher(backend, max_batch=64, window_ms=400.0)
+        server, port = await serve(
+            state, RateLimiter(10_000, 10_000), port=0, batcher=batcher
+        )
+        try:
+            async with AuthClient(f"127.0.0.1:{port}") as client:
+                users = [f"storm{i}" for i in range(4)]
+                pairs = [
+                    await _register_and_prove(client, u, rng, params) for u in users
+                ]
+                # 50ms client deadlines vs a 400ms batch window: every RPC
+                # times out client-side while queued
+                resps = await asyncio.gather(
+                    *[
+                        client.verify_proof(u, cid, pf, timeout=0.05)
+                        for u, (cid, pf) in zip(users, pairs)
+                    ],
+                    return_exceptions=True,
+                )
+                for r in resps:
+                    assert isinstance(r, grpc.RpcError)
+                    assert r.code() == grpc.StatusCode.DEADLINE_EXCEEDED
+                await asyncio.sleep(0.6)  # let the window drain the queue
+                assert backend.rows_verified == 0
+
+                # the same server still serves a well-behaved login
+                cid, pf = await _register_and_prove(client, "calm", rng, params)
+                resp = await client.verify_proof("calm", cid, pf, timeout=5.0)
+                assert resp.success
+        finally:
+            await batcher.stop()
+            await server.stop(None)
+
+    run(main())
+
+
+# --- overload shedding -------------------------------------------------------
+
+
+def test_grpc_overload_shed_resource_exhausted():
+    """Submissions beyond the queue cap get RESOURCE_EXHAUSTED immediately;
+    queued ones still verify."""
+
+    async def main():
+        rng = SecureRng()
+        params = Parameters.new()
+        state = ServerState()
+        batcher = DynamicBatcher(
+            CpuBackend(), max_batch=64, window_ms=250.0, max_queue=2
+        )
+        server, port = await serve(
+            state, RateLimiter(10_000, 10_000), port=0, batcher=batcher
+        )
+        try:
+            async with AuthClient(f"127.0.0.1:{port}") as client:
+                users = [f"flood{i}" for i in range(6)]
+                pairs = [
+                    await _register_and_prove(client, u, rng, params) for u in users
+                ]
+                resps = await asyncio.gather(
+                    *[
+                        client.verify_proof(u, cid, pf)
+                        for u, (cid, pf) in zip(users, pairs)
+                    ],
+                    return_exceptions=True,
+                )
+                ok = [r for r in resps if not isinstance(r, Exception)]
+                shed = [r for r in resps if isinstance(r, grpc.RpcError)]
+                assert len(ok) + len(shed) == 6
+                assert ok and all(r.success for r in ok)
+                assert shed, "queue cap of 2 must shed some of 6 concurrent RPCs"
+                assert all(
+                    r.code() == grpc.StatusCode.RESOURCE_EXHAUSTED for r in shed
+                )
+        finally:
+            await batcher.stop()
+            await server.stop(None)
+
+    run(main())
+
+
+def test_queue_depth_gauge_counts_inflight_entries():
+    """Satellite fix: while a device batch is in flight the depth gauge
+    reports its entries (not 0), and backpressure accounts for them."""
+    params, proofs = make_proofs(4)
+    release = threading.Event()
+    entered = threading.Event()
+
+    class GatedBackend(CpuBackend):
+        def verify_each(self, rows):
+            entered.set()
+            release.wait(10.0)
+            return super().verify_each(rows)
+
+    async def main():
+        batcher = DynamicBatcher(
+            GatedBackend(), max_batch=4, window_ms=1.0, max_queue=4
+        )
+        batcher.start()
+        coros = [
+            asyncio.ensure_future(batcher.submit(params, st, pr, None))
+            for st, pr in proofs
+        ]
+        await asyncio.to_thread(entered.wait, 10.0)
+        # the queue itself is drained, but 4 entries are claimed in flight
+        assert len(batcher._queue) == 0
+        depth_during = metrics.read("tpu.queue.depth", kind="g")
+        # in-flight entries count into backpressure too
+        with pytest.raises(QueueFull):
+            await batcher.submit(params, proofs[0][0], proofs[0][1], None)
+        release.set()
+        results = await asyncio.gather(*coros)
+        await batcher.stop()
+        return depth_during, results
+
+    depth_during, results = run(main())
+    assert depth_during == 4.0
+    assert results == [None] * 4
+    assert metrics.read("tpu.queue.depth", kind="g") == 0.0
+
+
+# --- health + degradation observability --------------------------------------
+
+
+def test_health_stays_serving_while_degraded():
+    """Satellite: an open breaker must NOT flip gRPC health — the fallback
+    still answers — but state and degraded-seconds gauges must tell on it."""
+
+    async def main():
+        rng = SecureRng()
+        params = Parameters.new()
+        state = ServerState()
+        fault = FaultInjectionBackend(CpuBackend(), FaultPlan().fail_after(0))
+        backend = FailoverBackend(fault, CpuBackend(), recovery_after_s=None)
+        batcher = DynamicBatcher(backend, max_batch=64, window_ms=5.0)
+        server, port = await serve(
+            state, RateLimiter(10_000, 10_000), port=0,
+            backend=backend, batcher=batcher,
+        )
+        try:
+            async with AuthClient(f"127.0.0.1:{port}") as client:
+                # >= 2 concurrent proofs: single-entry batches bypass the
+                # backend (BatchVerifier short-circuits n == 1 inline)
+                async def wave(tag):
+                    users = [f"{tag}{i}" for i in range(2)]
+                    pairs = [
+                        await _register_and_prove(client, u, rng, params)
+                        for u in users
+                    ]
+                    resps = await asyncio.gather(
+                        *[
+                            client.verify_proof(u, cid, pf)
+                            for u, (cid, pf) in zip(users, pairs)
+                        ]
+                    )
+                    assert all(r.success for r in resps)  # fallback answered
+
+                await wave("degraded")
+                assert backend.degraded
+
+                from cpzk_tpu.server.proto import load_health_pb2
+
+                hpb2 = load_health_pb2()
+                health = await client.health_check()
+                assert health.status == hpb2.HealthCheckResponse.ServingStatus.SERVING
+
+                await asyncio.sleep(0.05)
+                await wave("still-degraded")
+        finally:
+            await batcher.stop()
+            await server.stop(None)
+
+    run(main())
+    assert metrics.read("tpu.backend.state", kind="g") == 1.0  # open
+    assert metrics.read("tpu.backend.degraded_seconds", kind="g") >= 0.05
+
+
+def test_status_repl_reports_breaker_state():
+    from cpzk_tpu.server.__main__ import handle_command
+
+    fault = FaultInjectionBackend(CpuBackend(), FaultPlan().fail_after(0))
+    backend = FailoverBackend(fault, CpuBackend(), recovery_after_s=None)
+
+    async def main():
+        state = ServerState()
+        out, _ = await handle_command("/status", state, backend)
+        assert "backend=closed" in out
+
+        params, proofs = make_proofs(2)
+        bv = BatchVerifier(backend=backend)
+        for st, pr in proofs:
+            bv.add(params, st, pr)
+        await asyncio.to_thread(bv.verify, SecureRng())
+        out, _ = await handle_command("/status", state, backend)
+        assert "backend=open" in out and "degraded_for=" in out
+
+        out, _ = await handle_command("/reset", state, backend)
+        assert "re-armed" in out
+        assert backend.state is BreakerState.CLOSED
+
+        # inline CPU path: no backend to report
+        out, _ = await handle_command("/status", state, None)
+        assert "backend=" not in out
+
+    run(main())
+
+
+# --- client retries ----------------------------------------------------------
+
+
+def test_retry_policy_backoff_and_budget():
+    rng = random.Random(0)
+    pol = RetryPolicy(
+        max_attempts=4,
+        initial_backoff_s=0.1,
+        max_backoff_s=0.5,
+        multiplier=2.0,
+        budget=RetryBudget(tokens=2.0, token_ratio=0.5),
+    )
+    for attempt, cap in ((1, 0.1), (2, 0.2), (3, 0.4), (4, 0.5), (10, 0.5)):
+        for _ in range(20):
+            assert 0.0 <= pol.backoff_s(attempt, rng) <= cap
+
+    assert not pol.should_retry("PERMISSION_DENIED", 1)  # non-transient
+    assert not pol.should_retry("UNAVAILABLE", 4)  # attempts exhausted
+    assert pol.should_retry("UNAVAILABLE", 1)  # budget 2 -> 1
+    assert pol.should_retry("RESOURCE_EXHAUSTED", 2)  # budget 1 -> 0
+    assert not pol.should_retry("UNAVAILABLE", 1)  # budget exhausted
+    pol.note_success()
+    pol.note_success()
+    assert pol.should_retry("UNAVAILABLE", 1)  # refilled 2 * 0.5
+
+    with pytest.raises(ValueError):
+        RetryPolicy(max_attempts=0)
+    with pytest.raises(ValueError):
+        RetryPolicy(multiplier=0.5)
+    with pytest.raises(ValueError):
+        RetryBudget(tokens=0)
+
+
+class FakeRpcError(grpc.RpcError):
+    def __init__(self, code):
+        self._code = code
+
+    def code(self):
+        return self._code
+
+
+def _fast_policy(**kw):
+    kw.setdefault("max_attempts", 4)
+    kw.setdefault("initial_backoff_s", 0.001)
+    kw.setdefault("max_backoff_s", 0.002)
+    return RetryPolicy(**kw)
+
+
+def test_client_retries_transient_codes_only_for_safe_rpcs():
+    async def main():
+        state = ServerState()
+        server, port = await serve(state, RateLimiter(10_000, 10_000), port=0)
+        try:
+            client = AuthClient(
+                f"127.0.0.1:{port}",
+                retry=_fast_policy(),
+                retry_rng=random.Random(7),
+            )
+            async with client:
+                rng = SecureRng()
+                params = Parameters.new()
+
+                # CreateChallenge: idempotent-safe, retried through UNAVAILABLE
+                await _register_only(client, "retry-user", rng, params)
+                attempts = {"n": 0}
+                real = client._stubs["CreateChallenge"]
+
+                async def flaky(request, timeout=None):
+                    attempts["n"] += 1
+                    if attempts["n"] <= 2:
+                        raise FakeRpcError(grpc.StatusCode.UNAVAILABLE)
+                    return await real(request, timeout=timeout)
+
+                client._stubs["CreateChallenge"] = flaky
+                resp = await client.create_challenge("retry-user")
+                assert resp.challenge_id and attempts["n"] == 3
+
+                # non-transient codes are not retried even on safe RPCs
+                attempts["n"] = 10  # stub now always delegates
+                denied = {"n": 0}
+
+                async def denied_stub(request, timeout=None):
+                    denied["n"] += 1
+                    raise FakeRpcError(grpc.StatusCode.INVALID_ARGUMENT)
+
+                client._stubs["Register"] = denied_stub
+                with pytest.raises(grpc.RpcError):
+                    await client.register("x", b"a", b"b")
+                assert denied["n"] == 1
+
+                # VerifyProof: NEVER retried (challenge consumed on first
+                # receipt server-side; a resend cannot succeed)
+                vattempts = {"n": 0}
+
+                async def flaky_verify(request, timeout=None):
+                    vattempts["n"] += 1
+                    raise FakeRpcError(grpc.StatusCode.UNAVAILABLE)
+
+                client._stubs["VerifyProof"] = flaky_verify
+                with pytest.raises(grpc.RpcError):
+                    await client.verify_proof("retry-user", b"c" * 32, b"p" * 8)
+                assert vattempts["n"] == 1
+
+                # budget exhaustion fails fast instead of retry-storming
+                budget_client_attempts = {"n": 0}
+
+                async def always_down(request, timeout=None):
+                    budget_client_attempts["n"] += 1
+                    raise FakeRpcError(grpc.StatusCode.UNAVAILABLE)
+
+                client.retry = _fast_policy(
+                    max_attempts=10, budget=RetryBudget(tokens=2.0, token_ratio=0.0)
+                )
+                client._stubs["CreateChallenge"] = always_down
+                with pytest.raises(grpc.RpcError):
+                    await client.create_challenge("retry-user")
+                assert budget_client_attempts["n"] == 3  # initial + 2 budgeted
+        finally:
+            await server.stop(None)
+
+    run(main())
+
+
+def test_client_without_policy_never_retries():
+    async def main():
+        state = ServerState()
+        server, port = await serve(state, RateLimiter(10_000, 10_000), port=0)
+        try:
+            async with AuthClient(f"127.0.0.1:{port}") as client:
+                attempts = {"n": 0}
+
+                async def down(request, timeout=None):
+                    attempts["n"] += 1
+                    raise FakeRpcError(grpc.StatusCode.UNAVAILABLE)
+
+                client._stubs["CreateChallenge"] = down
+                with pytest.raises(grpc.RpcError):
+                    await client.create_challenge("nobody")
+                assert attempts["n"] == 1
+        finally:
+            await server.stop(None)
+
+    run(main())
+
+
+# --- the full acceptance scenario --------------------------------------------
+
+
+async def _register_only(client, user, rng, params):
+    prover = Prover(params, Witness(Ristretto255.random_scalar(rng)))
+    st = prover.statement
+    resp = await client.register(
+        user,
+        Ristretto255.element_to_bytes(st.y1),
+        Ristretto255.element_to_bytes(st.y2),
+    )
+    assert resp.success
+    return prover
+
+
+async def _register_and_prove(client, user, rng, params, tamper=False):
+    prover = await _register_only(client, user, rng, params)
+    ch = await client.create_challenge(user)
+    t = Transcript()
+    if tamper:
+        # bind the proof to the WRONG context: parses fine, fails verify —
+        # ground truth must reject it on every backend, every state
+        t.append_context(b"\x00" * 32)
+    else:
+        t.append_context(bytes(ch.challenge_id))
+    proof = prover.prove_with_transcript(rng, t)
+    return bytes(ch.challenge_id), proof.to_bytes()
+
+
+def test_chaos_device_loss_flap_recover_full_stack():
+    """Acceptance criterion: a TPU that fails mid-batch, flaps, then
+    stabilizes ends with the breaker CLOSED (back on TPU), zero wrong
+    accept/reject results versus CPU ground truth, and nothing wrongly
+    shed along the way."""
+    # primary-exercised batches: 0 fail -> OPEN; 1 probe-fail -> OPEN;
+    # 2 probe-ok -> CLOSED; 3 fail -> OPEN; 4 probe-ok -> CLOSED; 5+ stable
+    plan = FaultPlan(seed=5).fail_on(0, 1, 3)
+    fault = FaultInjectionBackend(CpuBackend(), plan)
+    backend = FailoverBackend(
+        fault, CpuBackend(), recovery_after_s=0.05, probe_batch_max=8
+    )
+    expired_before = metrics.read("tpu.queue.expired")
+
+    async def main():
+        rng = SecureRng()
+        params = Parameters.new()
+        state = ServerState()
+        batcher = DynamicBatcher(backend, max_batch=64, window_ms=15.0)
+        server, port = await serve(
+            state, RateLimiter(100_000, 100_000), port=0,
+            backend=backend, batcher=batcher,
+        )
+        states_seen = set()
+        try:
+            async with AuthClient(f"127.0.0.1:{port}") as client:
+                for wave in range(12):
+                    users = [f"w{wave}u{i}" for i in range(3)]
+                    pairs = [
+                        await _register_and_prove(
+                            client, u, rng, params, tamper=(i == 2)
+                        )
+                        for i, u in enumerate(users)
+                    ]
+                    resps = await asyncio.gather(
+                        *[
+                            client.verify_proof(u, cid, pf)
+                            for u, (cid, pf) in zip(users, pairs)
+                        ],
+                        return_exceptions=True,
+                    )
+                    # zero wrong results, regardless of breaker state:
+                    # good proofs authenticate, the tampered one never does
+                    for i, r in enumerate(resps):
+                        if i == 2:
+                            assert isinstance(r, grpc.RpcError), (wave, i)
+                            assert r.code() == grpc.StatusCode.PERMISSION_DENIED
+                        else:
+                            assert not isinstance(r, Exception), (wave, i, r)
+                            assert r.success and r.session_token
+                    states_seen.add(backend.state)
+                    if (
+                        wave >= 5
+                        and backend.state is BreakerState.CLOSED
+                        and fault.batches_seen >= 5
+                    ):
+                        break
+                    await asyncio.sleep(0.08)  # serve the breaker cooldown
+
+                # stabilized: breaker closed, traffic back on the primary
+                assert backend.state is BreakerState.CLOSED
+                assert not backend.degraded
+                before = fault.batches_seen
+                users = ["finalwave0", "finalwave1"]  # n >= 2: hits the backend
+                pairs = [
+                    await _register_and_prove(client, u, rng, params)
+                    for u in users
+                ]
+                resps = await asyncio.gather(
+                    *[
+                        client.verify_proof(u, cid, pf)
+                        for u, (cid, pf) in zip(users, pairs)
+                    ]
+                )
+                assert all(r.success for r in resps)
+                assert fault.batches_seen > before  # the TPU plane served it
+        finally:
+            await batcher.stop()
+            await server.stop(None)
+        return states_seen
+
+    states_seen = run(main())
+    assert fault.faults_raised == 3  # the full injected schedule ran
+    assert BreakerState.OPEN in states_seen  # it really did degrade
+    # nothing was wrongly shed as expired during the chaos
+    assert metrics.read("tpu.queue.expired") == expired_before
+
+
+def test_latency_spikes_do_not_trip_the_breaker():
+    """Slow-but-correct batches are not failures: latency spikes ride
+    through the pipeline without opening the breaker."""
+    params, proofs = make_proofs(3)
+    fault = FaultInjectionBackend(
+        CpuBackend(), FaultPlan(seed=9).latency(0.03, every=2)
+    )
+    backend = FailoverBackend(fault, CpuBackend(), recovery_after_s=0.05)
+
+    async def main():
+        batcher = DynamicBatcher(backend, max_batch=2, window_ms=2.0)
+        batcher.start()
+        results = await asyncio.gather(
+            *[batcher.submit(params, st, pr, None) for st, pr in proofs]
+        )
+        await batcher.stop()
+        return results
+
+    assert run(main()) == [None] * 3
+    assert backend.state is BreakerState.CLOSED
+    assert fault.batches_seen >= 1 and fault.faults_raised == 0

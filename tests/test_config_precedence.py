@@ -103,6 +103,58 @@ def test_empty_primary_env_beats_alias(clean_env, monkeypatch):
     assert cfg.rate_limit.burst == 7
 
 
+def test_resilience_knob_layering(clean_env, monkeypatch):
+    """The resilience knobs (breaker recovery, probe size, deadline shed,
+    client retry budget) resolve through the same precedence chain as
+    every other setting: TOML < env < CLI."""
+    (clean_env / "server.toml").write_text(
+        "[tpu]\nrecovery_after_s = 9.5\nprobe_batch_max = 16\n"
+        "shed_expired = false\n"
+        "[retry]\nmax_attempts = 7\nbudget = 2.5\n"
+    )
+    monkeypatch.setenv("SERVER_TPU_RECOVERY_AFTER_S", "4.0")
+    monkeypatch.setenv("SERVER_TPU_SHED_EXPIRED", "true")
+    monkeypatch.setenv("SERVER_RETRY_BUDGET", "3.5")
+    monkeypatch.setenv("SERVER_RETRY_INITIAL_BACKOFF_MS", "25")
+    cfg = resolve_config(parse_args([]))
+    assert cfg.tpu.recovery_after_s == 4.0      # env beats TOML
+    assert cfg.tpu.probe_batch_max == 16        # TOML beats default
+    assert cfg.tpu.shed_expired is True         # env beats TOML
+    assert cfg.retry.max_attempts == 7          # TOML beats default
+    assert cfg.retry.budget == 3.5              # env beats TOML
+    assert cfg.retry.initial_backoff_ms == 25.0
+
+    policy = cfg.retry.build_policy()
+    assert policy is not None
+    assert policy.max_attempts == 7
+    assert policy.initial_backoff_s == 0.025
+    assert policy.budget is not None and policy.budget.tokens == 3.5
+
+
+def test_resilience_knob_validation(clean_env):
+    cfg = ServerConfig()
+    cfg.tpu.recovery_after_s = -2.0
+    with pytest.raises(ValueError):
+        cfg.validate()
+    cfg.tpu.recovery_after_s = -1.0  # sentinel: never self-heal
+    cfg.validate()
+
+    cfg = ServerConfig()
+    cfg.tpu.probe_batch_max = 0
+    with pytest.raises(ValueError):
+        cfg.validate()
+
+    cfg = ServerConfig()
+    cfg.retry.multiplier = 0.5
+    with pytest.raises(ValueError):
+        cfg.validate()
+
+    cfg = ServerConfig()
+    cfg.retry.budget = 0.0
+    cfg.validate()  # valid: retries disabled
+    assert cfg.retry.build_policy() is None
+
+
 def test_empty_int_env_keeps_default(clean_env, monkeypatch):
     """Deployment templates render optional vars as "": that must keep the
     default (and suppress the alias), not crash int("") at startup."""

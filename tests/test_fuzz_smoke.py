@@ -1,0 +1,45 @@
+"""Fast fuzz smoke: bounded runs of the fuzz/ targets inside the default
+suite (resilience subsystem satellite — the adversarial parser surfaces
+get exercised on every CI run, not only when someone remembers to run the
+standalone fuzzers).
+
+Uses the harness's built-in seeded mutation engine via
+``common.run_bounded`` (deterministic; Atheris, when installed, is
+deliberately bypassed because it ignores bounds).  Budget: well under
+30 s for both targets together on one core.
+"""
+
+import importlib.util
+import os
+import sys
+
+import pytest
+
+FUZZ_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "fuzz"
+)
+
+
+def _load(name: str):
+    if FUZZ_DIR not in sys.path:
+        sys.path.insert(0, FUZZ_DIR)  # targets do `from common import ...`
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(FUZZ_DIR, f"{name}.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.mark.parametrize(
+    "target,runs",
+    [
+        ("fuzz_proof_deserialization", 120),
+        ("fuzz_statement_validation", 400),
+    ],
+)
+def test_fuzz_target_smoke(target, runs):
+    common = _load("common")
+    mod = _load(target)
+    done = common.run_bounded(mod.one_input, mod._seeds(), runs=runs, seed=1234)
+    assert done == runs  # raises on the first invariant violation

@@ -254,6 +254,103 @@ def test_state_file_config_layering(tmp_path, monkeypatch):
     assert ServerConfig.from_env().state_file == "/tmp/a.json"
 
 
+def test_snapshot_oserror_mid_write_preserves_previous(tmp_path):
+    """Fault-injected OSError mid-``write()`` (resilience subsystem,
+    ``SnapshotFaults``): the injected failure lands after the JSON bytes
+    hit the tmp file but before the rename — the previous snapshot must
+    stay intact, tmp debris must be cleaned up, and the dirty flag must
+    re-arm so the next sweep retries."""
+    from cpzk_tpu.resilience.faults import FaultPlan, SnapshotFaults
+
+    rng, params = SecureRng(), Parameters.new()
+    path = str(tmp_path / "state.json")
+
+    async def main():
+        st = ServerState()
+        await st.register_user(UserData("u0", make_statement(rng, params), 1))
+        assert await st.snapshot(path) is True  # good baseline snapshot
+
+        await st.create_session("tok", "u0")  # re-dirty
+        with SnapshotFaults(FaultPlan().snapshot_errors(1)):
+            with pytest.raises(OSError):
+                await st.snapshot(path)
+
+        # previous snapshot intact: restores the pre-crash document
+        st2 = ServerState()
+        nu, ns = await st2.restore(path)
+        assert (nu, ns) == (1, 0)  # the session never made it to disk
+
+        # the crashed write left no tmp debris holding bearer tokens
+        assert sorted(os.listdir(tmp_path.as_posix())) == ["state.json"]
+
+        # dirty flag re-armed: the next (un-faulted) snapshot catches up
+        assert await st.snapshot(path) is True
+        st3 = ServerState()
+        nu, ns = await st3.restore(path)
+        assert (nu, ns) == (1, 1)
+        assert await st3.validate_session("tok") == "u0"
+
+    run(main())
+
+
+def test_snapshot_repeated_io_errors_then_recovery(tmp_path):
+    """A run of injected write failures (flaky disk) never corrupts the
+    on-disk document; the first clean write lands the full state."""
+    from cpzk_tpu.resilience.faults import FaultPlan, SnapshotFaults
+
+    rng, params = SecureRng(), Parameters.new()
+    path = str(tmp_path / "state.json")
+
+    async def main():
+        st = ServerState()
+        await st.register_user(UserData("u0", make_statement(rng, params), 1))
+        assert await st.snapshot(path) is True
+        plan = FaultPlan().snapshot_errors(3)
+        with SnapshotFaults(plan):
+            for i in range(3):
+                await st.create_session(f"tok-{i}", "u0")
+                with pytest.raises(OSError):
+                    await st.snapshot(path)
+                assert json.load(open(path))["sessions"] == []  # untouched
+            # 4th write: fault budget exhausted, passes through
+            assert await st.snapshot(path) is True
+        st2 = ServerState()
+        nu, ns = await st2.restore(path)
+        assert (nu, ns) == (1, 3)
+
+    run(main())
+
+
+def test_restore_partial_write_leaves_state_empty_and_retryable(tmp_path):
+    """A torn half-document (what a crash WITHOUT the atomic-rename
+    protocol would leave) fails loudly and all-or-nothing: nothing loads,
+    and the same ServerState instance still restores a good file."""
+    rng, params = SecureRng(), Parameters.new()
+    path = str(tmp_path / "state.json")
+
+    async def main():
+        st = ServerState()
+        await st.register_user(UserData("u0", make_statement(rng, params), 1))
+        await st.create_session("tok", "u0")
+        await st.snapshot(path)
+        good = open(path, "rb").read()
+
+        fresh = ServerState()
+        for cut in (1, len(good) // 2, len(good) - 2):
+            with open(path, "wb") as f:
+                f.write(good[:cut])  # torn write
+            with pytest.raises((Error, ValueError, KeyError, TypeError)):
+                await fresh.restore(path)
+            assert await fresh.user_count() == 0  # nothing leaked
+
+        with open(path, "wb") as f:
+            f.write(good)
+        nu, ns = await fresh.restore(path)
+        assert (nu, ns) == (1, 1)
+
+    run(main())
+
+
 def test_restore_survives_mutated_snapshots(tmp_path):
     """Random structural mutations of a valid snapshot must either load
     cleanly or raise Error/ValueError-family exceptions — never crash the
