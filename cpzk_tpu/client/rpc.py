@@ -21,6 +21,7 @@ import random
 
 import grpc
 
+from ..observability.context import RequestContext
 from ..resilience.retry import RetryPolicy
 from ..server.proto import SERVICE_NAME, load_pb2, method_types
 
@@ -42,6 +43,8 @@ class AuthClient:
     ):
         self.pb2 = load_pb2()
         self.retry = retry
+        #: trace context of the most recent RPC attempt (observability).
+        self.last_context: RequestContext | None = None
         # injectable RNG so chaos tests get deterministic jitter
         self._retry_rng = retry_rng or random.Random()
         if credentials is not None:
@@ -73,21 +76,37 @@ class AuthClient:
         """One RPC through the retry policy.  Non-idempotent methods (and
         clients with no policy) go straight through; the rest retry only
         on the policy's transient codes, sleeping full-jitter backoff,
-        until attempts or the shared budget run out."""
+        until attempts or the shared budget run out.
+
+        Every attempt carries a trace context in its gRPC metadata: the
+        trace id is minted ONCE per logical call and stays stable across
+        retries while the attempt number increments, so the server-side
+        trace ring shows a retried request as one trace with several
+        completions.  The most recent context is kept on
+        ``self.last_context`` for callers that want to correlate their
+        own logs with the server's."""
+        rctx = RequestContext()
+        self.last_context = rctx
         policy = self.retry
         if policy is None or name not in _RETRY_SAFE:
-            return await stub(request, timeout=timeout)
-        attempt = 0
+            return await stub(
+                request, timeout=timeout, metadata=rctx.to_metadata()
+            )
         while True:
-            attempt += 1
             try:
-                response = await stub(request, timeout=timeout)
+                response = await stub(
+                    request, timeout=timeout, metadata=rctx.to_metadata()
+                )
             except grpc.RpcError as e:
                 code = e.code()
                 code_name = code.name if code is not None else ""
-                if not policy.should_retry(code_name, attempt):
+                if not policy.should_retry(code_name, rctx.attempt):
                     raise
-                await asyncio.sleep(policy.backoff_s(attempt, self._retry_rng))
+                await asyncio.sleep(
+                    policy.backoff_s(rctx.attempt, self._retry_rng)
+                )
+                rctx = rctx.child()  # same trace id, attempt + 1
+                self.last_context = rctx
                 continue
             policy.note_success()
             return response

@@ -1,0 +1,73 @@
+"""End-to-end request tracing + latency-breakdown telemetry.
+
+The diagnostic substrate for the TPU serving plane (ROADMAP north star:
+know *where* a request spent its time before optimizing it):
+
+- :mod:`.context` — ``RequestContext`` minted at the client, carried in
+  gRPC metadata, stable across retries;
+- :mod:`.tracing` — per-stage software spans (``queue_wait``,
+  ``pad_and_pack``, ``device_dispatch``, ``unpack``), a completed-trace
+  ring buffer behind the admin REPL's ``/tracez``, and
+  ``jax.profiler.TraceAnnotation`` alignment so xprof shows the same
+  stage names;
+- :mod:`.instrument` — the ``traced_rpc`` decorator owning the
+  requests/outcome/duration metric lifecycle for every RPC handler;
+- :mod:`.logs` — the opt-in JSON log formatter with automatic trace-id
+  correlation.
+
+``configure(settings)`` applies an ``[observability]`` config section to
+the process-wide tracer, metric buckets, and log format in one call.
+"""
+
+from __future__ import annotations
+
+from .context import RequestContext, current_context, new_trace_id
+from .instrument import rpc_deadline, traced_rpc
+from .logs import JsonLogFormatter, enable_json_logs
+from .tracing import (
+    BatchStages,
+    SpanRecord,
+    TraceRecord,
+    Tracer,
+    format_trace,
+    format_tracez,
+    get_tracer,
+)
+
+__all__ = [
+    "BatchStages",
+    "JsonLogFormatter",
+    "RequestContext",
+    "SpanRecord",
+    "TraceRecord",
+    "Tracer",
+    "configure",
+    "current_context",
+    "enable_json_logs",
+    "format_trace",
+    "format_tracez",
+    "get_tracer",
+    "new_trace_id",
+    "rpc_deadline",
+    "traced_rpc",
+]
+
+
+def configure(settings) -> None:
+    """Apply an ``ObservabilitySettings`` (see ``server/config.py``):
+    trace ring capacity, slow-request threshold, histogram buckets, and
+    the JSON log formatter opt-in."""
+    from ..server import metrics
+
+    get_tracer().configure(
+        capacity=settings.trace_ring,
+        slow_request_s=(
+            -1.0 if settings.slow_request_ms < 0
+            else settings.slow_request_ms / 1000.0
+        ),
+    )
+    buckets = settings.parsed_buckets()
+    if buckets:
+        metrics.set_default_buckets(buckets)
+    if settings.json_logs:
+        enable_json_logs()

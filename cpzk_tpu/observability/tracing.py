@@ -1,0 +1,291 @@
+"""Software spans + an in-memory completed-trace ring buffer.
+
+This is deliberately not an OpenTelemetry dependency: the serving stack
+needs (a) per-request stage breakdowns it can assert on in tests and show
+an operator in the admin REPL, and (b) span names that line up with xprof
+device timelines — both are a few hundred lines of stdlib, and the
+container bakes no OTel SDK.  The shapes mirror OTel loosely (trace id,
+named spans with start offsets and durations, attributes) so a real
+exporter can be bolted onto :meth:`Tracer.completed` later.
+
+Thread-safety: spans are recorded from batcher worker threads while the
+owning RPC task awaits its future, so every mutation is lock-guarded.
+The ring only holds *completed* traces; in-flight ones live in a dict
+keyed by trace id (one active attempt per trace id at a time — a PR-1
+retry reuses the id with a bumped attempt, producing one ring entry per
+attempt).
+
+``TraceAnnotation`` alignment: :class:`BatchStages` wraps each software
+stage in ``jax.profiler.TraceAnnotation("cpzk.<stage>")`` when jax is
+already imported, so an xprof capture (CPZK_XPROF_DIR) shows the exact
+same stage names the ring buffer reports — software queue math and device
+HLO sit on one timeline.
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+import time
+from collections import deque
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+
+from ..server import metrics
+from .context import RequestContext, new_trace_id
+
+#: Canonical pipeline stage names (doc + test vocabulary).  ``queue_wait``
+#: and ``device_dispatch`` bracket the device; ``pad_and_pack`` /
+#: ``unpack`` are the host stages around it.
+STAGE_QUEUE_WAIT = "queue_wait"
+STAGE_PAD_AND_PACK = "pad_and_pack"
+STAGE_DEVICE_DISPATCH = "device_dispatch"
+STAGE_UNPACK = "unpack"
+
+#: Which stage feeds which latency histogram.
+_STAGE_HISTOGRAM = {
+    STAGE_PAD_AND_PACK: "tpu.batch.host_time",
+    STAGE_UNPACK: "tpu.batch.host_time",
+    STAGE_DEVICE_DISPATCH: "tpu.batch.device_time",
+}
+
+
+@dataclass
+class SpanRecord:
+    """One completed stage within a trace."""
+
+    name: str
+    #: ``time.monotonic()`` at stage entry.
+    start: float
+    duration_s: float
+    attrs: dict = field(default_factory=dict)
+
+
+@dataclass
+class TraceRecord:
+    """One completed (or in-flight) request attempt."""
+
+    trace_id: str
+    name: str  # RPC / operation name
+    attempt: int = 1
+    start_wall: float = 0.0  # time.time() at trace start
+    start: float = 0.0       # time.monotonic() at trace start
+    duration_s: float = 0.0
+    status: str = "in-flight"
+    spans: list[SpanRecord] = field(default_factory=list)
+
+    def span_names(self) -> list[str]:
+        return [s.name for s in self.spans]
+
+    def stage_seconds(self, name: str) -> float:
+        """Total recorded duration of all spans named ``name``."""
+        return sum(s.duration_s for s in self.spans if s.name == name)
+
+
+class Tracer:
+    """Active-trace registry + completed-trace ring buffer."""
+
+    def __init__(self, capacity: int = 256, slow_request_s: float = 1.0):
+        self._lock = threading.Lock()
+        self._active: dict[str, TraceRecord] = {}
+        self._ring: deque[TraceRecord] = deque(maxlen=max(1, capacity))
+        #: Requests slower than this log a WARNING with their stage
+        #: breakdown; 0 logs every request, None/negative disables.
+        self.slow_request_s: float | None = slow_request_s
+
+    # -- configuration ------------------------------------------------------
+
+    def configure(
+        self,
+        capacity: int | None = None,
+        slow_request_s: float | None = None,
+    ) -> None:
+        with self._lock:
+            if capacity is not None and capacity != self._ring.maxlen:
+                self._ring = deque(self._ring, maxlen=max(1, capacity))
+            if slow_request_s is not None:
+                self.slow_request_s = (
+                    None if slow_request_s < 0 else slow_request_s
+                )
+
+    def clear(self) -> None:
+        with self._lock:
+            self._active.clear()
+            self._ring.clear()
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self, ctx: RequestContext, name: str) -> TraceRecord:
+        """Open a trace for ``ctx``.  A second ``start`` with the same
+        trace id (a retry's next attempt) replaces the in-flight record —
+        each attempt completes into its own ring entry."""
+        rec = TraceRecord(
+            trace_id=ctx.trace_id,
+            name=name,
+            attempt=ctx.attempt,
+            start_wall=time.time(),
+            start=time.monotonic(),
+        )
+        with self._lock:
+            self._active[ctx.trace_id] = rec
+        return rec
+
+    def add_span(
+        self,
+        trace_id: str | None,
+        name: str,
+        start: float,
+        duration_s: float,
+        **attrs,
+    ) -> None:
+        """Attach a completed span to an in-flight trace; silently dropped
+        when the trace is unknown (entry submitted outside an instrumented
+        RPC, or the trace already finished)."""
+        if not trace_id:
+            return
+        with self._lock:
+            rec = self._active.get(trace_id)
+            if rec is not None:
+                rec.spans.append(
+                    SpanRecord(name, start, max(0.0, duration_s), dict(attrs))
+                )
+
+    def finish(
+        self, trace_id: str, status: str, duration_s: float | None = None
+    ) -> TraceRecord | None:
+        """Complete the in-flight trace and move it into the ring."""
+        with self._lock:
+            rec = self._active.pop(trace_id, None)
+            if rec is None:
+                return None
+            rec.status = status
+            rec.duration_s = (
+                duration_s
+                if duration_s is not None
+                else max(0.0, time.monotonic() - rec.start)
+            )
+            self._ring.append(rec)
+        return rec
+
+    def record_event(self, name: str, **attrs) -> TraceRecord:
+        """A standalone zero-duration event (breaker flip, failover) as a
+        single-span completed trace, so state transitions share the
+        ``/tracez`` timeline with the requests they affected."""
+        now = time.monotonic()
+        rec = TraceRecord(
+            trace_id=new_trace_id(),
+            name=name,
+            start_wall=time.time(),
+            start=now,
+            status="event",
+        )
+        rec.spans.append(SpanRecord(name, now, 0.0, dict(attrs)))
+        with self._lock:
+            self._ring.append(rec)
+        return rec
+
+    # -- inspection ---------------------------------------------------------
+
+    def completed(self, n: int | None = None) -> list[TraceRecord]:
+        """Most-recent-last snapshot of completed traces (last ``n``)."""
+        with self._lock:
+            out = list(self._ring)
+        return out if n is None else out[-n:]
+
+    def find(self, trace_id: str) -> list[TraceRecord]:
+        """All completed attempts of one trace id, oldest first."""
+        return [t for t in self.completed() if t.trace_id == trace_id]
+
+
+_TRACER = Tracer()
+
+
+def get_tracer() -> Tracer:
+    """The process-wide tracer (configure via ``observability.configure``)."""
+    return _TRACER
+
+
+# -- xprof alignment ---------------------------------------------------------
+
+
+def _trace_annotation(name: str):
+    """``jax.profiler.TraceAnnotation`` when jax is already loaded (the
+    serving process on the TPU path), else a null context — the software
+    span must never pay a cold jax import on the inline CPU path."""
+    jax = sys.modules.get("jax")
+    if jax is not None:
+        try:
+            return jax.profiler.TraceAnnotation(f"cpzk.{name}")
+        except Exception:  # pragma: no cover - stub jax without profiler
+            pass
+
+    @contextmanager
+    def _null():
+        yield
+
+    return _null()
+
+
+class BatchStages:
+    """Stage recorder handed to ``BatchVerifier.verify``: each stage is
+    timed once per device batch and fanned out as a span to every member
+    trace, observed into the stage latency histograms, and wrapped in a
+    matching ``TraceAnnotation`` so xprof shows the same stage names."""
+
+    def __init__(
+        self,
+        tracer: Tracer | None,
+        trace_ids: list[str],
+        batch_size: int = 0,
+        backend_label: str = "cpu",
+    ):
+        self.tracer = tracer
+        self.trace_ids = [t for t in trace_ids if t]
+        self.batch_size = batch_size
+        self.backend_label = backend_label
+
+    @contextmanager
+    def stage(self, name: str):
+        t0 = time.monotonic()
+        with _trace_annotation(name):
+            yield
+        dur = time.monotonic() - t0
+        hist = _STAGE_HISTOGRAM.get(name)
+        if hist == "tpu.batch.device_time":
+            metrics.histogram(hist, labelnames=("backend",)).labels(
+                backend=self.backend_label
+            ).observe(dur)
+        elif hist is not None:
+            metrics.histogram(hist).observe(dur)
+        if self.tracer is not None:
+            for tid in self.trace_ids:
+                self.tracer.add_span(
+                    tid, name, t0, dur,
+                    batch=self.batch_size, backend=self.backend_label,
+                )
+
+
+# -- operator rendering -------------------------------------------------------
+
+
+def format_trace(rec: TraceRecord) -> str:
+    """One ``/tracez`` line: id, name, outcome, total, stage breakdown."""
+    stages = " ".join(
+        f"{s.name}={s.duration_s * 1000:.2f}ms" for s in rec.spans
+    )
+    head = (
+        f"{rec.trace_id[:16]} {rec.name} {rec.status} "
+        f"total={rec.duration_s * 1000:.2f}ms attempt={rec.attempt}"
+    )
+    return f"{head} {stages}".rstrip()
+
+
+def format_tracez(traces: list[TraceRecord], limit: int = 20) -> str:
+    """The admin REPL ``/tracez`` body: last ``limit`` traces, newest
+    first, one line each."""
+    recent = traces[-limit:][::-1]
+    if not recent:
+        return "no completed traces yet"
+    lines = [f"last {len(recent)} completed traces (newest first):"]
+    lines += ["  " + format_trace(t) for t in recent]
+    return "\n".join(lines)

@@ -83,6 +83,20 @@ if LANE_CHUNK % min(LANE_QUANTUM, LANE_CHUNK):
     LANE_CHUNK = _rounded
 
 
+def _note_pad_waste(n: int, pad: int) -> None:
+    """Batch-shape telemetry: fraction of device lanes burned on padding
+    for the most recent batch (``tpu.batch.pad_waste`` gauge).  Metrics
+    live in the server layer; this module stays importable without it."""
+    try:
+        from ..server import metrics
+
+        metrics.gauge("tpu.batch.pad_waste").set(
+            (pad - n) / pad if pad > 0 else 0.0
+        )
+    except Exception:  # pragma: no cover - server layer unavailable
+        pass
+
+
 def _pad_pow2(n: int) -> int:
     size = 1
     while size < n:
@@ -394,6 +408,7 @@ class TpuBackend(VerifierBackend):
         debug = os.environ.get("CPZK_BATCH_DEBUG") == "1"
         t0 = time.perf_counter() if debug else 0.0
         pad = _pad_lanes(n + 1)
+        _note_pad_waste(n + 1, pad)
         r1 = _elems_soa([r.r1 for r in rows] + [rows[0].g], pad)
         y1 = _elems_soa([r.y1 for r in rows] + [rows[0].h], pad)
         r2 = _elems_soa([r.r2 for r in rows], pad)
@@ -453,6 +468,7 @@ class TpuBackend(VerifierBackend):
         # it is used EXACTLY; above it, quantum padding keeps the waste to
         # under one LANE_QUANTUM of identity terms
         m_pad = m if m <= LANE_CHUNK else _pad_lanes(m)
+        _note_pad_waste(4 * len(rows) + 2, m_pad)
         pts = _elems_soa(elems, m_pad)
         if device_rlc:
             digits = _pippenger_digits_device(rows, beta, m_pad, c)
@@ -479,6 +495,7 @@ class TpuBackend(VerifierBackend):
     def verify_each(self, rows: list[BatchRow]) -> list[bool]:
         n = len(rows)
         pad = _pad_lanes(n)
+        _note_pad_waste(n, pad)
         shared = all(r.g == rows[0].g and r.h == rows[0].h for r in rows)
         if shared:
             g, h = self._gh(rows[0])

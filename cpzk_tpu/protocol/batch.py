@@ -28,6 +28,7 @@ north star).
 
 from __future__ import annotations
 
+import contextlib
 from dataclasses import dataclass
 
 from ..errors import Error, InvalidParams, InvalidProofEncoding
@@ -42,6 +43,20 @@ from .verifier import Verifier
 MAX_BATCH_SIZE = 1000
 
 
+class _NullStages:
+    """Inert stage recorder: ``BatchVerifier.verify`` always runs under a
+    stage scope, instrumented or not (the real recorder lives in
+    :mod:`cpzk_tpu.observability.tracing` — this layer stays import-free
+    of it)."""
+
+    def stage(self, name: str):
+        del name
+        return contextlib.nullcontext()
+
+
+_NULL_STAGES = _NullStages()
+
+
 @dataclass
 class BatchEntry:
     params: Parameters
@@ -53,6 +68,12 @@ class BatchEntry:
     #: layer); ``None`` = wait forever.  The dynamic batcher sheds expired
     #: entries before device dispatch instead of verifying them.
     deadline: float | None = None
+    #: trace id of the RPC that queued this entry (observability subsystem);
+    #: the batcher fans per-stage spans out to every member trace.
+    trace_id: str | None = None
+    #: ``time.monotonic()`` at enqueue, stamped by the batcher — the
+    #: ``queue_wait`` span/histogram measures from here to dispatch.
+    enqueued_at: float | None = None
 
 
 @dataclass
@@ -284,6 +305,15 @@ class FailoverBackend(VerifierBackend):
             )
         except Exception:
             pass
+        try:  # transition also lands in the trace ring buffer, so degraded
+            # periods share the /tracez timeline with the requests they hit
+            from ..observability import get_tracer
+
+            get_tracer().record_event(
+                "breaker_transition", old=old.value, new=new.value,
+            )
+        except Exception:
+            pass
 
     def _touch_degraded_gauge(self) -> None:
         try:
@@ -482,12 +512,18 @@ class BatchVerifier:
             )
         return rows
 
-    def verify(self, rng: SecureRng) -> list[Error | None]:
+    def verify(self, rng: SecureRng, stages=None) -> list[Error | None]:
         """Verify all entries; per-entry ``None`` (ok) or ``Error``.
 
         Mirrors batch.rs:171-183: empty batch is an error; n == 1 verifies
         individually; otherwise the combined check decides the fast path and
         failure falls back to per-proof results.
+
+        ``stages`` is an optional stage recorder (duck-typed like
+        :class:`cpzk_tpu.observability.BatchStages`): host prep is timed
+        under ``pad_and_pack``, the backend call(s) under
+        ``device_dispatch``, and result assembly under ``unpack`` — the
+        latency-breakdown seam the serving layer's traces report through.
 
         Deferred-parse proofs (see :meth:`Proof.from_bytes_batch`) settle
         their postponed commitment decodes here: backends that report
@@ -498,6 +534,7 @@ class BatchVerifier:
         """
         if not self.entries:
             raise InvalidParams("Cannot verify empty batch")
+        st = stages if stages is not None else _NULL_STAGES
         n = len(self.entries)
         backend = self.backend
         same_generators = all(
@@ -531,28 +568,49 @@ class BatchVerifier:
                 return results
 
         if n == 1:
-            return [self._verify_one(0)]
+            # single-entry batches keep the same stage decomposition so a
+            # trace through a lightly-loaded batcher still breaks down
+            entry = self.entries[0]
+            with st.stage("pad_and_pack"):
+                transcript = Transcript()
+                if entry.transcript_context is not None:
+                    transcript.append_context(entry.transcript_context)
+                verifier = Verifier(entry.params, entry.statement)
+            with st.stage("device_dispatch"):
+                try:
+                    verifier.verify_with_transcript(entry.proof, transcript)
+                    result: Error | None = None
+                except Error as exc:
+                    result = exc
+            with st.stage("unpack"):
+                return [result]
 
-        rows = self.prepare_rows(rng)
-        beta = Ristretto255.random_scalar(rng)
-        if (
-            same_generators
-            and backend.prefers_combined
-            and backend.verify_combined(rows, beta)
-        ):
-            return [None] * len(rows)
-
-        # Fallback: per-proof ground truth (batch.rs:314-318)
-        results = []
-        for ok in backend.verify_each(rows):
-            if ok == 2:  # deferred commitment wire failed to decode
-                results.append(InvalidProofEncoding(
-                    "Bytes do not represent a valid Ristretto point"))
-            elif ok:
-                results.append(None)
+        with st.stage("pad_and_pack"):
+            rows = self.prepare_rows(rng)
+            beta = Ristretto255.random_scalar(rng)
+        with st.stage("device_dispatch"):
+            if (
+                same_generators
+                and backend.prefers_combined
+                and backend.verify_combined(rows, beta)
+            ):
+                statuses = None
             else:
-                results.append(InvalidParams("Proof verification failed"))
-        return results
+                # Fallback: per-proof ground truth (batch.rs:314-318)
+                statuses = backend.verify_each(rows)
+        with st.stage("unpack"):
+            if statuses is None:
+                return [None] * len(rows)
+            results = []
+            for ok in statuses:
+                if ok == 2:  # deferred commitment wire failed to decode
+                    results.append(InvalidProofEncoding(
+                        "Bytes do not represent a valid Ristretto point"))
+                elif ok:
+                    results.append(None)
+                else:
+                    results.append(InvalidParams("Proof verification failed"))
+            return results
 
     def _screen_deferred(self) -> dict[int, Error]:
         """Settle deferred proofs' postponed point decodes eagerly: one
@@ -586,15 +644,3 @@ class BatchVerifier:
                 out[i] = InvalidProofEncoding(
                     "Bytes do not represent a valid Ristretto point")
         return out
-
-    def _verify_one(self, index: int) -> Error | None:
-        entry = self.entries[index]
-        transcript = Transcript()
-        if entry.transcript_context is not None:
-            transcript.append_context(entry.transcript_context)
-        verifier = Verifier(entry.params, entry.statement)
-        try:
-            verifier.verify_with_transcript(entry.proof, transcript)
-            return None
-        except Error as exc:
-            return exc

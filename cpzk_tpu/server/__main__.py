@@ -139,6 +139,7 @@ async def cleanup_supervisor(
 
 HELP = """Available commands:
   /status      (/st)  server status summary (incl. backend breaker state)
+  /tracez [N]  (/tz)  last N completed request traces w/ stage breakdown
   /users       (/u)   registered user count
   /sessions    (/s)   active session count
   /challenges  (/c)   pending challenge count
@@ -174,6 +175,15 @@ async def handle_command(
                 f" expired_shed={int(metrics.read('tpu.queue.expired'))}"
             )
         return line, False
+    if word in ("/tracez", "/traces", "/tz"):
+        from ..observability import format_tracez, get_tracer
+
+        parts = cmd.split()
+        try:
+            limit = int(parts[1]) if len(parts) > 1 else 20
+        except ValueError:
+            return f"usage: /tracez [N] — not a number: {parts[1]}", False
+        return format_tracez(get_tracer().completed(), limit=max(1, limit)), False
     if word in ("/reset", "/rearm"):
         if backend is None or not hasattr(backend, "breaker"):
             return "no failover backend to reset (inline CPU path)", False
@@ -232,6 +242,14 @@ async def amain(args) -> None:
         level=os.environ.get("RUST_LOG", os.environ.get("LOG_LEVEL", "INFO")).upper(),
         format="%(asctime)s %(levelname)s %(name)s: %(message)s",
     )
+
+    # observability: trace ring size, slow-request threshold, histogram
+    # buckets, and the (opt-in) JSON log formatter — before any RPC runs
+    from ..observability import configure as configure_observability
+
+    configure_observability(config.observability)
+    if config.observability.json_logs:
+        log.info("structured JSON logging enabled")
 
     state = ServerState()
     if config.state_file and os.path.exists(config.state_file):

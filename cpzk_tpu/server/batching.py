@@ -25,6 +25,13 @@ claimed-by-in-flight-dispatches — cannot go stale at 0 under
 pipelining), ``tpu.batch.fill_ratio``, ``tpu.batch.latency`` (histogram),
 ``tpu.batch.proofs`` / ``tpu.queue.shed`` / ``tpu.queue.expired``
 (counters).
+
+Tracing (observability subsystem): entries carry the submitting RPC's
+trace id; each dispatch records a per-entry ``queue_wait`` span (and
+histogram) plus batch-level ``pad_and_pack`` / ``device_dispatch`` /
+``unpack`` stage spans via :class:`~cpzk_tpu.observability.BatchStages`,
+with ``tpu.batch.host_time`` / ``tpu.batch.device_time`` histograms —
+the latency-breakdown substrate docs/operations.md §Telemetry documents.
 """
 
 from __future__ import annotations
@@ -37,6 +44,7 @@ import time
 
 from ..core.rng import SecureRng
 from ..errors import Error
+from ..observability.tracing import BatchStages, get_tracer
 from ..protocol.batch import BatchEntry, BatchVerifier, VerifierBackend
 from ..protocol.gadgets import Parameters, Proof, Statement
 from . import metrics
@@ -119,12 +127,18 @@ class DynamicBatcher:
         proof: Proof,
         context: bytes | None,
         deadline: float | None = None,
+        trace_id: str | None = None,
     ) -> Error | None:
         """Queue one proof; resolves to ``None`` (ok) or the ``Error``.
         ``deadline`` is an absolute ``time.monotonic()`` point (the RPC
         deadline); past it the entry is shed instead of verified and the
-        await raises :class:`DeadlineExceeded`."""
-        entry = BatchEntry(params, statement, proof, context, deadline=deadline)
+        await raises :class:`DeadlineExceeded`.  ``trace_id`` ties the
+        entry's stage spans (queue_wait, pad_and_pack, device_dispatch,
+        unpack) to the submitting RPC's trace."""
+        entry = BatchEntry(
+            params, statement, proof, context,
+            deadline=deadline, trace_id=trace_id,
+        )
         return (await self.submit_many([entry]))[0]
 
     async def submit_many(
@@ -141,10 +155,15 @@ class DynamicBatcher:
         together and returned in order."""
         if not entries:
             return []
+        now = time.monotonic()
+        for entry in entries:
+            entry.enqueued_at = now
         if self._stopping or self._task is None or self._task.done():
             # shutdown window (stop() ran but the listener is still up) or
             # batcher never started: verify inline with identical semantics
-            return await asyncio.to_thread(self._verify, entries)
+            return await asyncio.to_thread(
+                self._verify, entries, self._stages_for(entries)
+            )
         # backpressure over the whole pipeline: queued entries PLUS entries
         # already claimed by in-flight dispatches — otherwise a deep
         # pipeline accepts up to pipeline_depth*max_batch extra work the
@@ -295,6 +314,41 @@ class DynamicBatcher:
             self._set_depth_gauge()
         self._resolve_expired(expired)
 
+    def _backend_label(self) -> str:
+        """Which compute plane this batch lands on, for the ``backend``
+        label of ``tpu.batch.device_time`` ("fallback" while a failover
+        wrapper is degraded)."""
+        backend = self.backend
+        if backend is None:
+            return "cpu"
+        if hasattr(backend, "degraded"):
+            return "fallback" if backend.degraded else "primary"
+        name = type(backend).__name__.removesuffix("Backend").lower()
+        return name or "custom"
+
+    def _stages_for(self, entries: list[BatchEntry]) -> BatchStages:
+        return BatchStages(
+            get_tracer(),
+            [e.trace_id for e in entries],
+            batch_size=len(entries),
+            backend_label=self._backend_label(),
+        )
+
+    def _note_queue_wait(self, entries: list[BatchEntry]) -> None:
+        """queue_wait span + histogram per entry, measured from enqueue to
+        the moment its batch is committed to dispatch."""
+        now = time.monotonic()
+        tracer = get_tracer()
+        hist = metrics.histogram("tpu.batch.queue_wait")
+        for entry in entries:
+            if entry.enqueued_at is None:
+                continue
+            wait = max(0.0, now - entry.enqueued_at)
+            hist.observe(wait)
+            tracer.add_span(
+                entry.trace_id, "queue_wait", entry.enqueued_at, wait
+            )
+
     async def _dispatch(self, take: list[tuple[BatchEntry, asyncio.Future]]) -> None:
         # entries can also expire between the drain-loop slice and this
         # dispatch actually running (pipeline backpressure waits on the
@@ -308,9 +362,12 @@ class DynamicBatcher:
         futs = [f for _, f in take]
         metrics.gauge("tpu.batch.fill_ratio").set(len(entries) / self.max_batch)
         metrics.counter("tpu.batch.proofs").inc(len(entries))
+        self._note_queue_wait(entries)
         t0 = time.perf_counter()
         try:
-            results = await asyncio.to_thread(self._verify, entries)
+            results = await asyncio.to_thread(
+                self._verify, entries, self._stages_for(entries)
+            )
         except Exception as exc:  # backend blew up past all failovers
             log.exception("batch dispatch failed")
             for fut in futs:
@@ -322,19 +379,24 @@ class DynamicBatcher:
             if not fut.done():
                 fut.set_result(res)
 
-    def _verify(self, entries: list[BatchEntry]) -> list[Error | None]:
+    def _verify(
+        self, entries: list[BatchEntry], stages: BatchStages | None = None
+    ) -> list[Error | None]:
         bv = BatchVerifier(backend=self.backend, max_size=max(len(entries), 1))
         bv.entries.extend(entries)  # already validated at RPC ingress
         xprof = os.environ.get("CPZK_XPROF_DIR")
         if xprof:
             # JAX profiler (xprof) trace around the device dispatch —
             # SURVEY.md §5 tracing/profiling TPU addition; inspect with
-            # tensorboard --logdir $CPZK_XPROF_DIR
+            # tensorboard --logdir $CPZK_XPROF_DIR.  The per-stage
+            # TraceAnnotations emitted by ``stages`` nest inside this
+            # capture, so the xprof timeline carries the same
+            # pad_and_pack/device_dispatch/unpack names as /tracez.
             import jax
 
             with jax.profiler.trace(xprof):
                 with jax.profiler.TraceAnnotation("cpzk_batch_verify"):
-                    return bv.verify(self._rng)
+                    return bv.verify(self._rng, stages=stages)
         if os.environ.get("CPZK_BATCH_DEBUG") == "1":
             # stage decomposition for the gRPC-on-device collapse
             # investigation (PROFILE.md §7c): per-batch wall split between
@@ -343,9 +405,9 @@ class DynamicBatcher:
             import time as _t
 
             t0 = _t.perf_counter()
-            out = bv.verify(self._rng)
+            out = bv.verify(self._rng, stages=stages)
             print(f"[batch-debug] n={len(entries)} "
                   f"verify={_t.perf_counter() - t0:.3f}s",
                   file=sys.stderr, flush=True)
             return out
-        return bv.verify(self._rng)
+        return bv.verify(self._rng, stages=stages)

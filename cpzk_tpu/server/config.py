@@ -65,6 +65,30 @@ class TpuSettings:
 
 
 @dataclass
+class ObservabilitySettings:
+    """Tracing/telemetry knobs (observability subsystem): the JSON log
+    formatter opt-in, the slow-request WARNING threshold, the completed-
+    trace ring capacity behind the admin REPL's ``/tracez``, and an
+    optional override of the TPU-tuned histogram bucket schedule."""
+
+    json_logs: bool = False        # structured JSON log records (opt-in)
+    slow_request_ms: float = 1000.0  # >= this logs a WARNING with stage
+                                     # breakdown; 0 logs every request,
+                                     # -1 disables slow-request logging
+    trace_ring: int = 256          # completed traces kept for /tracez
+    latency_buckets_ms: str = ""   # comma-separated upper bounds in ms;
+                                   # empty keeps the built-in schedule
+
+    def parsed_buckets(self) -> list[float]:
+        """Bucket bounds in SECONDS from the ms-denominated config string
+        (empty list = keep the metrics module's built-in default)."""
+        text = self.latency_buckets_ms.strip()
+        if not text:
+            return []
+        return [float(part) / 1000.0 for part in text.split(",") if part.strip()]
+
+
+@dataclass
 class RetrySettings:
     """Client retry knobs (resilience subsystem): exponential backoff with
     full jitter and a shared retry budget, applied by ``AuthClient`` to
@@ -103,6 +127,9 @@ class ServerConfig:
     tls: TlsSettings = field(default_factory=TlsSettings)
     tpu: TpuSettings = field(default_factory=TpuSettings)
     retry: RetrySettings = field(default_factory=RetrySettings)
+    observability: ObservabilitySettings = field(
+        default_factory=ObservabilitySettings
+    )
 
     def addr(self) -> str:
         return f"{self.host}:{self.port}"
@@ -133,6 +160,7 @@ class ServerConfig:
             ("tls", self.tls),
             ("tpu", self.tpu),
             ("retry", self.retry),
+            ("observability", self.observability),
         ):
             for key, value in data.get(section, {}).items():
                 if hasattr(obj, key):
@@ -209,6 +237,15 @@ class ServerConfig:
             self.retry.budget = float(v)
         if (v := get("RETRY_TOKEN_RATIO")) is not None:
             self.retry.token_ratio = float(v)
+        # observability knobs (short OBS_* aliases mirror the section name)
+        if (v := get_alias("OBSERVABILITY_JSON_LOGS", "OBS_JSON_LOGS")) is not None:
+            self.observability.json_logs = v.lower() in ("1", "true", "yes", "on")
+        if (v := get_alias("OBSERVABILITY_SLOW_REQUEST_MS", "OBS_SLOW_REQUEST_MS")) is not None:
+            self.observability.slow_request_ms = float(v)
+        if (v := get_alias("OBSERVABILITY_TRACE_RING", "OBS_TRACE_RING")) is not None:
+            self.observability.trace_ring = int(v)
+        if (v := get_alias("OBSERVABILITY_LATENCY_BUCKETS_MS", "OBS_LATENCY_BUCKETS_MS")) is not None:
+            self.observability.latency_buckets_ms = v
 
     # --- validation (config.rs:238-273) ---
 
@@ -252,6 +289,26 @@ class ServerConfig:
             raise ValueError("retry.multiplier must be >= 1")
         if self.retry.budget < 0:
             raise ValueError("retry.budget cannot be negative")
+        if self.observability.trace_ring < 1:
+            raise ValueError("observability.trace_ring must be >= 1")
+        if (
+            self.observability.slow_request_ms < 0
+            and self.observability.slow_request_ms != -1
+        ):
+            raise ValueError(
+                "observability.slow_request_ms must be >= 0, or -1 to disable"
+            )
+        try:
+            buckets = self.observability.parsed_buckets()
+        except ValueError:
+            raise ValueError(
+                "observability.latency_buckets_ms must be a comma-separated "
+                "list of numbers"
+            ) from None
+        if buckets and sorted(buckets) != buckets:
+            raise ValueError(
+                "observability.latency_buckets_ms must be sorted ascending"
+            )
 
 
 def _load_dotenv() -> None:

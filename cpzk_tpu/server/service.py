@@ -20,6 +20,7 @@ from .. import errors
 from ..core.ristretto import Ristretto255
 from ..core.rng import SecureRng
 from ..core.transcript import Transcript
+from ..observability import current_context, traced_rpc
 from ..protocol.batch import BatchVerifier, VerifierBackend
 from ..protocol.gadgets import Parameters, Proof, Statement
 from ..protocol.verifier import Verifier
@@ -72,17 +73,18 @@ class AuthServiceImpl:
             await context.abort(grpc.StatusCode.INVALID_ARGUMENT, msg)
 
     @staticmethod
-    def _rpc_deadline(context) -> float | None:
-        """Absolute ``time.monotonic()`` deadline of this RPC, or None when
-        the client set none.  Threaded into queued ``BatchEntry``s so the
-        dynamic batcher can shed entries nobody is waiting for anymore."""
-        try:
-            remaining = context.time_remaining()
-        except Exception:  # hand-rolled test contexts without deadlines
-            return None
-        if remaining is None:
-            return None
-        return time.monotonic() + max(0.0, remaining)
+    def _request_context(context):
+        """The decorator-minted :class:`RequestContext` of this RPC (trace
+        id + absolute deadline), or a fresh one when the handler was
+        invoked outside ``traced_rpc`` (hand-rolled test harnesses)."""
+        rctx = current_context.get()
+        if rctx is None:
+            from ..observability import RequestContext, rpc_deadline
+
+            rctx = RequestContext.from_grpc(
+                context, deadline=rpc_deadline(context)
+            )
+        return rctx
 
     def _parse_statement(self, y1_bytes: bytes, y2_bytes: bytes) -> Statement:
         """Shared register-path statement validation; raises errors.Error
@@ -106,9 +108,13 @@ class AuthServiceImpl:
 
     # --- RPCs ---
 
+    # requests/success/failure counters and the duration histogram for
+    # every RPC live in the traced_rpc decorator (one lifecycle, no
+    # skipped .observe() on early-abort paths); handler bodies keep only
+    # their domain-specific counters.
+
+    @traced_rpc("Register", "auth.register")
     async def register(self, request, context):
-        start = time.perf_counter()
-        metrics.counter("auth.register.requests").inc()
         await self._check_rate(context)
         await self._validate_user_id(request.user_id, context)
 
@@ -120,7 +126,6 @@ class AuthServiceImpl:
         try:
             statement = self._parse_statement(request.y1, request.y2)
         except errors.Error as e:
-            metrics.counter("auth.register.failure").inc()
             await context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
 
         try:
@@ -132,20 +137,15 @@ class AuthServiceImpl:
                 )
             )
         except errors.Error as e:
-            metrics.counter("auth.register.failure").inc()
-            metrics.histogram("auth.register.duration").observe(time.perf_counter() - start)
             await context.abort(grpc.StatusCode.ALREADY_EXISTS, f"Registration failed: {e}")
 
-        metrics.counter("auth.register.success").inc()
-        metrics.histogram("auth.register.duration").observe(time.perf_counter() - start)
         return self.pb2.RegistrationResponse(
             success=True,
             message=f"User '{request.user_id}' registered successfully",
         )
 
+    @traced_rpc("RegisterBatch", "auth.register_batch")
     async def register_batch(self, request, context):
-        start = time.perf_counter()
-        metrics.counter("auth.register_batch.requests").inc()
         await self._check_rate(context)
 
         n = len(request.user_ids)
@@ -203,13 +203,10 @@ class AuthServiceImpl:
             )
             metrics.counter("auth.register_batch.individual_success").inc()
 
-        metrics.histogram("auth.register_batch.duration").observe(time.perf_counter() - start)
-        metrics.counter("auth.register_batch.success").inc()
         return self.pb2.BatchRegistrationResponse(results=results)
 
+    @traced_rpc("CreateChallenge", "auth.challenge")
     async def create_challenge(self, request, context):
-        start = time.perf_counter()
-        metrics.counter("auth.challenge.requests").inc()
         await self._check_rate(context)
         await self._validate_user_id(request.user_id, context)
 
@@ -223,19 +220,14 @@ class AuthServiceImpl:
         try:
             expires_at = await self.state.create_challenge(user.user_id, challenge_id)
         except errors.Error as e:
-            metrics.counter("auth.challenge.failure").inc()
-            metrics.histogram("auth.challenge.duration").observe(time.perf_counter() - start)
             await context.abort(
                 grpc.StatusCode.RESOURCE_EXHAUSTED, f"Challenge creation failed: {e}"
             )
 
-        metrics.counter("auth.challenge.success").inc()
-        metrics.histogram("auth.challenge.duration").observe(time.perf_counter() - start)
         return self.pb2.ChallengeResponse(challenge_id=challenge_id, expires_at=expires_at)
 
+    @traced_rpc("VerifyProof", "auth.verify")
     async def verify_proof(self, request, context):
-        start = time.perf_counter()
-        metrics.counter("auth.verify.requests").inc()
         await self._check_rate(context)
         await self._validate_user_id(request.user_id, context)
 
@@ -246,39 +238,35 @@ class AuthServiceImpl:
         try:
             challenge = await self.state.consume_challenge(request.challenge_id)
         except errors.Error:
-            metrics.counter("auth.verify.failure").inc()
             await context.abort(grpc.StatusCode.PERMISSION_DENIED, "Authentication failed")
         if challenge.user_id != request.user_id:
-            metrics.counter("auth.verify.failure").inc()
             await context.abort(grpc.StatusCode.PERMISSION_DENIED, "Authentication failed")
 
         user = await self.state.get_user(request.user_id)
         if user is None:
-            metrics.counter("auth.verify.failure").inc()
             await context.abort(grpc.StatusCode.PERMISSION_DENIED, "Authentication failed")
 
         try:
             proof = Proof.from_bytes(request.proof)
         except errors.Error as e:
-            metrics.counter("auth.verify.failure").inc()
             await context.abort(grpc.StatusCode.INVALID_ARGUMENT, f"Invalid proof: {e}")
 
         if self.batcher is not None:
             # TPU serving path: coalesce with concurrent RPCs into one
             # device batch; per-entry result has identical semantics
+            rctx = self._request_context(context)
             try:
                 verify_err = await self.batcher.submit(
                     Parameters.new(), user.statement, proof,
                     bytes(request.challenge_id),
-                    deadline=self._rpc_deadline(context),
+                    deadline=rctx.deadline,
+                    trace_id=rctx.trace_id,
                 )
             except batching.QueueFull:
-                metrics.counter("auth.verify.failure").inc()
                 await context.abort(
                     grpc.StatusCode.RESOURCE_EXHAUSTED, "Server overloaded"
                 )
             except batching.DeadlineExceeded:
-                metrics.counter("auth.verify.failure").inc()
                 await context.abort(
                     grpc.StatusCode.DEADLINE_EXCEEDED,
                     "Deadline expired before verification",
@@ -293,8 +281,6 @@ class AuthServiceImpl:
             except errors.Error as e:
                 verify_err = e
         if verify_err is not None:
-            metrics.counter("auth.verify.failure").inc()
-            metrics.histogram("auth.verify.duration").observe(time.perf_counter() - start)
             await context.abort(
                 grpc.StatusCode.PERMISSION_DENIED, f"Verification failed: {verify_err}"
             )
@@ -303,21 +289,16 @@ class AuthServiceImpl:
         try:
             await self.state.create_session(token, request.user_id)
         except errors.Error as e:
-            metrics.counter("auth.verify.failure").inc()
-            metrics.histogram("auth.verify.duration").observe(time.perf_counter() - start)
             await context.abort(grpc.StatusCode.INTERNAL, f"Failed to create session: {e}")
 
-        metrics.counter("auth.verify.success").inc()
-        metrics.histogram("auth.verify.duration").observe(time.perf_counter() - start)
         return self.pb2.VerificationResponse(
             success=True,
             message=f"User '{request.user_id}' authenticated successfully",
             session_token=token,
         )
 
+    @traced_rpc("VerifyProofBatch", "auth.verify_batch")
     async def verify_proof_batch(self, request, context):
-        start = time.perf_counter()
-        metrics.counter("auth.verify_batch.requests").inc()
         await self._check_rate(context)
 
         n = len(request.user_ids)
@@ -404,9 +385,10 @@ class AuthServiceImpl:
                     # no orphaned sibling submits to drain on QueueFull.
                     # All entries share this RPC's deadline: past it the
                     # batcher sheds them instead of burning device time.
-                    deadline = self._rpc_deadline(context)
+                    rctx = self._request_context(context)
                     for entry in batch.entries:
-                        entry.deadline = deadline
+                        entry.deadline = rctx.deadline
+                        entry.trace_id = rctx.trace_id
                     batch_results = await self.batcher.submit_many(batch.entries)
                 else:
                     # worker thread, not the event loop: the native verify
@@ -418,18 +400,15 @@ class AuthServiceImpl:
                         batch_results = await asyncio.to_thread(
                             batch.verify, self.rng)
             except batching.QueueFull:
-                metrics.counter("auth.verify_batch.failure").inc()
                 await context.abort(
                     grpc.StatusCode.RESOURCE_EXHAUSTED, "Server overloaded"
                 )
             except batching.DeadlineExceeded:
-                metrics.counter("auth.verify_batch.failure").inc()
                 await context.abort(
                     grpc.StatusCode.DEADLINE_EXCEEDED,
                     "Deadline expired before verification",
                 )
             except errors.Error as e:
-                metrics.counter("auth.verify_batch.failure").inc()
                 await context.abort(grpc.StatusCode.INTERNAL, f"Batch verification failed: {e}")
 
         # session issuance for verified items — one bulk mint (single lock,
@@ -490,8 +469,6 @@ class AuthServiceImpl:
         if n - n_failure:
             metrics.counter("auth.verify_batch.individual_success").inc(n - n_failure)
 
-        metrics.histogram("auth.verify_batch.duration").observe(time.perf_counter() - start)
-        metrics.counter("auth.verify_batch.success").inc()
         return self.pb2.BatchVerificationResponse(results=results)
 
 

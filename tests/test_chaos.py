@@ -380,10 +380,12 @@ def test_grpc_threads_rpc_deadline_into_batcher():
         captured = []
         orig_submit = batcher.submit
 
-        async def spy(params_, statement, proof, context, deadline=None):
+        async def spy(params_, statement, proof, context, deadline=None,
+                      trace_id=None):
             captured.append(deadline)
             return await orig_submit(
-                params_, statement, proof, context, deadline=deadline
+                params_, statement, proof, context, deadline=deadline,
+                trace_id=trace_id,
             )
 
         batcher.submit = spy
@@ -692,11 +694,11 @@ def test_client_retries_transient_codes_only_for_safe_rpcs():
                 attempts = {"n": 0}
                 real = client._stubs["CreateChallenge"]
 
-                async def flaky(request, timeout=None):
+                async def flaky(request, timeout=None, metadata=None):
                     attempts["n"] += 1
                     if attempts["n"] <= 2:
                         raise FakeRpcError(grpc.StatusCode.UNAVAILABLE)
-                    return await real(request, timeout=timeout)
+                    return await real(request, timeout=timeout, metadata=metadata)
 
                 client._stubs["CreateChallenge"] = flaky
                 resp = await client.create_challenge("retry-user")
@@ -706,7 +708,7 @@ def test_client_retries_transient_codes_only_for_safe_rpcs():
                 attempts["n"] = 10  # stub now always delegates
                 denied = {"n": 0}
 
-                async def denied_stub(request, timeout=None):
+                async def denied_stub(request, timeout=None, metadata=None):
                     denied["n"] += 1
                     raise FakeRpcError(grpc.StatusCode.INVALID_ARGUMENT)
 
@@ -719,7 +721,7 @@ def test_client_retries_transient_codes_only_for_safe_rpcs():
                 # receipt server-side; a resend cannot succeed)
                 vattempts = {"n": 0}
 
-                async def flaky_verify(request, timeout=None):
+                async def flaky_verify(request, timeout=None, metadata=None):
                     vattempts["n"] += 1
                     raise FakeRpcError(grpc.StatusCode.UNAVAILABLE)
 
@@ -731,7 +733,7 @@ def test_client_retries_transient_codes_only_for_safe_rpcs():
                 # budget exhaustion fails fast instead of retry-storming
                 budget_client_attempts = {"n": 0}
 
-                async def always_down(request, timeout=None):
+                async def always_down(request, timeout=None, metadata=None):
                     budget_client_attempts["n"] += 1
                     raise FakeRpcError(grpc.StatusCode.UNAVAILABLE)
 
@@ -756,7 +758,7 @@ def test_client_without_policy_never_retries():
             async with AuthClient(f"127.0.0.1:{port}") as client:
                 attempts = {"n": 0}
 
-                async def down(request, timeout=None):
+                async def down(request, timeout=None, metadata=None):
                     attempts["n"] += 1
                     raise FakeRpcError(grpc.StatusCode.UNAVAILABLE)
 

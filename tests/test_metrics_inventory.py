@@ -1,0 +1,140 @@
+"""CI drift guards for the telemetry surface (tier-1).
+
+1. The metrics namespace has no kind collisions: one name is only ever a
+   counter OR a gauge OR a histogram (a collision would blow up the
+   Prometheus exposition with a duplicated timeseries).
+2. Every metric name used in ``cpzk_tpu/`` appears in the documented
+   inventory in ``docs/operations.md`` — new instrumentation cannot ship
+   undocumented, and stale docs rows are caught by inspection.
+3. The whole metrics facade works with ``prometheus_client`` absent
+   (subprocess with the import blocked), exercising the no-op backing's
+   counters, labeled children, histogram count/sum, and reads.
+"""
+
+import os
+import pathlib
+import re
+import subprocess
+import sys
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+
+#: metric-creation calls with a literal name argument
+_LITERAL_CALL = re.compile(
+    r"""(?:metrics\.)?\b(counter|histogram|gauge)\(\s*['"]([a-z0-9._]+)['"]"""
+)
+
+#: names built dynamically (f-strings / dict lookups) that the regex scan
+#: cannot see, with their kinds: the per-RPC families from the traced_rpc
+#: decorator and the stage histograms fed by BatchStages.
+_KIND_C, _KIND_H = "c", "h"
+_RPC_PREFIXES = (
+    "auth.register",
+    "auth.register_batch",
+    "auth.challenge",
+    "auth.verify",
+    "auth.verify_batch",
+)
+DYNAMIC_NAMES: dict[str, str] = {}
+for _prefix in _RPC_PREFIXES:
+    DYNAMIC_NAMES[f"{_prefix}.requests"] = _KIND_C
+    DYNAMIC_NAMES[f"{_prefix}.success"] = _KIND_C
+    DYNAMIC_NAMES[f"{_prefix}.failure"] = _KIND_C
+    DYNAMIC_NAMES[f"{_prefix}.duration"] = _KIND_H
+DYNAMIC_NAMES["tpu.batch.host_time"] = _KIND_H
+DYNAMIC_NAMES["tpu.batch.device_time"] = _KIND_H
+
+
+def _collect_literal_names() -> dict[str, set[str]]:
+    kinds_by_name: dict[str, set[str]] = {}
+    for path in (ROOT / "cpzk_tpu").rglob("*.py"):
+        if path.name == "metrics.py":  # the facade itself, not a user
+            continue
+        for kind, name in _LITERAL_CALL.findall(path.read_text()):
+            kinds_by_name.setdefault(name, set()).add(kind[0])
+    return kinds_by_name
+
+
+def test_metric_registry_has_no_kind_collisions():
+    kinds_by_name = _collect_literal_names()
+    for name, kind in DYNAMIC_NAMES.items():
+        kinds_by_name.setdefault(name, set()).add(kind)
+    collisions = {
+        name: kinds for name, kinds in kinds_by_name.items() if len(kinds) > 1
+    }
+    assert not collisions, (
+        f"metric names used with conflicting kinds: {collisions}"
+    )
+    # sanity: the scan actually found the serving-plane metrics
+    assert "tpu.queue.depth" in kinds_by_name
+    assert "tpu.batch.queue_wait" in kinds_by_name
+    assert "rpc.requests" in kinds_by_name
+
+
+def test_every_metric_name_is_documented():
+    docs = (ROOT / "docs" / "operations.md").read_text()
+    kinds_by_name = _collect_literal_names()
+    used = set(kinds_by_name) | set(DYNAMIC_NAMES)
+    undocumented = sorted(
+        name for name in used if f"`{name}`" not in docs
+    )
+    assert not undocumented, (
+        "metric names used in cpzk_tpu/ but missing from the "
+        f"docs/operations.md telemetry inventory: {undocumented}"
+    )
+
+
+_NOOP_SCRIPT = """
+import importlib.abc, sys
+
+class _BlockPrometheus(importlib.abc.MetaPathFinder):
+    def find_spec(self, fullname, path, target=None):
+        if fullname.split(".")[0] == "prometheus_client":
+            raise ImportError("prometheus_client blocked for no-op test")
+        return None
+
+sys.meta_path.insert(0, _BlockPrometheus())
+
+from cpzk_tpu.server import metrics
+
+assert metrics.HAVE_PROMETHEUS is False
+
+c = metrics.counter("noop.test.count")
+c.inc()
+c.inc(2)
+assert metrics.read("noop.test.count") == 3.0
+
+h = metrics.histogram("noop.test.duration")
+h.observe(0.25)
+h.observe(0.75)
+assert metrics.read_histogram("noop.test.duration") == (2.0, 1.0)
+assert metrics.read("noop.test.duration", "h") == 1.0
+
+g = metrics.gauge("noop.test.depth")
+g.set(7)
+assert metrics.read("noop.test.depth", "g") == 7.0
+
+fam = metrics.counter("noop.test.labeled", labelnames=("rpc",))
+fam.labels(rpc="X").inc()
+assert metrics.read("noop.test.labeled", labels={"rpc": "X"}) == 1.0
+assert metrics.read("noop.test.labeled", labels={"rpc": "Y"}) == 0.0
+
+assert metrics.start_exporter("127.0.0.1", 0) is False
+assert ("c", "noop.test.count") in metrics.registered()
+print("NOOP-OK")
+"""
+
+
+def test_metrics_facade_without_prometheus_client():
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    result = subprocess.run(
+        [sys.executable, "-c", _NOOP_SCRIPT],
+        capture_output=True,
+        text=True,
+        cwd=str(ROOT),
+        env=env,
+        timeout=120,
+    )
+    assert result.returncode == 0, result.stderr
+    assert "NOOP-OK" in result.stdout

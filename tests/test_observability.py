@@ -1,0 +1,500 @@
+"""Observability subsystem tests: trace context propagation (client →
+gRPC metadata → service → BatchEntry → span ring buffer, stable across
+retries), per-stage latency spans through the DynamicBatcher, the
+metrics facade upgrade (labels, histogram count/sum reads, no-op
+accumulation), structured JSON logs, the ``/tracez`` admin command, and
+breaker transitions landing in the trace timeline.
+
+The end-to-end test is the PR's acceptance criterion: a ``VerifyProof``
+served through the batcher on CPU (conftest pins ``JAX_PLATFORMS=cpu``)
+must yield a completed trace whose queue/device/host stage spans are all
+recorded with non-negative durations, retrievable via the ring buffer
+API, visible in ``/tracez``, and carrying the same trace id as the
+structured JSON log line.
+"""
+
+import asyncio
+import json
+import logging
+
+import grpc
+import pytest
+
+from cpzk_tpu import Parameters, Prover, SecureRng, Transcript, Witness
+from cpzk_tpu.client import AuthClient
+from cpzk_tpu.core.ristretto import Ristretto255
+from cpzk_tpu.observability import (
+    JsonLogFormatter,
+    RequestContext,
+    current_context,
+    format_tracez,
+    get_tracer,
+)
+from cpzk_tpu.observability.context import ATTEMPT_KEY, TRACE_ID_KEY
+from cpzk_tpu.protocol.batch import (
+    BatchVerifier,
+    CpuBackend,
+    FailoverBackend,
+    VerifierBackend,
+)
+from cpzk_tpu.resilience.retry import RetryBudget, RetryPolicy
+from cpzk_tpu.server import RateLimiter, ServerConfig, ServerState, metrics
+from cpzk_tpu.server.__main__ import handle_command
+from cpzk_tpu.server.batching import DynamicBatcher
+from cpzk_tpu.server.service import AuthServiceImpl, make_generic_handler, serve
+
+STAGES = {"queue_wait", "pad_and_pack", "device_dispatch", "unpack"}
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_tracer():
+    tracer = get_tracer()
+    prev_slow = tracer.slow_request_s
+    tracer.clear()
+    yield
+    tracer.clear()
+    tracer.slow_request_s = prev_slow
+
+
+async def _register_and_prove(client, user, rng, params):
+    prover = Prover(params, Witness(Ristretto255.random_scalar(rng)))
+    st = prover.statement
+    resp = await client.register(
+        user,
+        Ristretto255.element_to_bytes(st.y1),
+        Ristretto255.element_to_bytes(st.y2),
+    )
+    assert resp.success
+    ch = await client.create_challenge(user)
+    t = Transcript()
+    t.append_context(bytes(ch.challenge_id))
+    proof = prover.prove_with_transcript(rng, t)
+    return bytes(ch.challenge_id), proof.to_bytes()
+
+
+class _CaptureJson(logging.Handler):
+    """Collects formatted JSON log lines."""
+
+    def __init__(self):
+        super().__init__()
+        self.lines: list[str] = []
+        self.setFormatter(JsonLogFormatter())
+
+    def emit(self, record):
+        self.lines.append(self.format(record))
+
+
+# --- acceptance: end-to-end trace through the batcher -----------------------
+
+
+def test_verify_proof_trace_end_to_end():
+    """VerifyProof through DynamicBatcher: completed trace with all stage
+    spans, /tracez visibility, and a JSON log line sharing the trace id."""
+    tracer = get_tracer()
+    tracer.slow_request_s = 0.0  # log every request
+    capture = _CaptureJson()
+    rpc_logger = logging.getLogger("cpzk_tpu.observability.rpc")
+    rpc_logger.addHandler(capture)
+
+    async def main():
+        rng = SecureRng()
+        params = Parameters.new()
+        state = ServerState()
+        batcher = DynamicBatcher(CpuBackend(), max_batch=64, window_ms=20.0)
+        server, port = await serve(
+            state, RateLimiter(10_000, 10_000),
+            host="127.0.0.1", port=0, batcher=batcher,
+        )
+        try:
+            async with AuthClient(f"127.0.0.1:{port}") as client:
+                users = [f"trace{i}" for i in range(3)]
+                pairs = [
+                    await _register_and_prove(client, u, rng, params)
+                    for u in users
+                ]
+                resps = await asyncio.gather(
+                    *[
+                        client.verify_proof(u, cid, pf)
+                        for u, (cid, pf) in zip(users, pairs)
+                    ]
+                )
+                assert all(r.success for r in resps)
+            return await handle_command("/tracez", state)
+        finally:
+            await batcher.stop()
+            await server.stop(None)
+
+    try:
+        tracez_out, quit_ = run(main())
+    finally:
+        rpc_logger.removeHandler(capture)
+
+    # -- ring buffer: every VerifyProof trace carries all four stages
+    verify_traces = [
+        t for t in tracer.completed() if t.name == "VerifyProof"
+    ]
+    assert len(verify_traces) == 3
+    for tr in verify_traces:
+        assert tr.status == "success"
+        assert tr.duration_s > 0
+        assert STAGES <= set(tr.span_names()), tr.span_names()
+        for span in tr.spans:
+            assert span.duration_s >= 0.0
+        # queue_wait + device_dispatch + host stages all non-negative
+        assert tr.stage_seconds("queue_wait") >= 0.0
+        assert tr.stage_seconds("device_dispatch") >= 0.0
+        host = tr.stage_seconds("pad_and_pack") + tr.stage_seconds("unpack")
+        assert host >= 0.0
+
+    # -- /tracez: the same traces are operator-visible
+    assert not quit_
+    assert "VerifyProof" in tracez_out
+    for tr in verify_traces:
+        assert tr.trace_id[:16] in tracez_out
+    assert "device_dispatch=" in tracez_out
+
+    # -- structured log: same trace id as the ring buffer records
+    logged = [json.loads(line) for line in capture.lines]
+    verify_logs = [l for l in logged if l.get("rpc") == "VerifyProof"]
+    assert {l["trace_id"] for l in verify_logs} == {
+        t.trace_id for t in verify_traces
+    }
+    for entry in verify_logs:
+        assert entry["outcome"] == "success"
+        assert entry["duration_ms"] >= 0
+        assert "queue_wait" in entry["stages_ms"]
+
+    # -- stage latency histograms observed on both planes
+    count, total = metrics.read_histogram("tpu.batch.queue_wait")
+    assert count >= 3 and total >= 0.0
+    assert metrics.read_histogram("tpu.batch.host_time")[0] >= 1
+    assert metrics.read_histogram(
+        "tpu.batch.device_time", labels={"backend": "cpu"}
+    )[0] >= 1
+
+
+def test_trace_metadata_survives_retry():
+    """Client-minted trace id arrives in gRPC metadata, stays stable
+    across a PR-1 retry while the attempt number increments, and the
+    final server-side trace records the retried attempt number."""
+    tracer = get_tracer()
+    seen: list[tuple[str | None, str | None]] = []
+
+    class FlakyService(AuthServiceImpl):
+        async def create_challenge(self, request, context):
+            md = {k.lower(): v for k, v in context.invocation_metadata()}
+            seen.append((md.get(TRACE_ID_KEY), md.get(ATTEMPT_KEY)))
+            if len(seen) == 1:
+                await context.abort(grpc.StatusCode.UNAVAILABLE, "flap")
+            return await AuthServiceImpl.create_challenge(
+                self, request, context
+            )
+
+    async def main():
+        state = ServerState()
+        service = FlakyService(state, RateLimiter(10_000, 10_000))
+        server = grpc.aio.server()
+        server.add_generic_rpc_handlers((make_generic_handler(service),))
+        port = server.add_insecure_port("127.0.0.1:0")
+        await server.start()
+        policy = RetryPolicy(
+            max_attempts=3,
+            initial_backoff_s=0.001,
+            max_backoff_s=0.002,
+            budget=RetryBudget(tokens=10.0, token_ratio=0.1),
+        )
+        try:
+            async with AuthClient(
+                f"127.0.0.1:{port}", retry=policy
+            ) as client:
+                rng = SecureRng()
+                params = Parameters.new()
+                prover = Prover(
+                    params, Witness(Ristretto255.random_scalar(rng))
+                )
+                st = prover.statement
+                resp = await client.register(
+                    "retryer",
+                    Ristretto255.element_to_bytes(st.y1),
+                    Ristretto255.element_to_bytes(st.y2),
+                )
+                assert resp.success
+                ch = await client.create_challenge("retryer")
+                assert ch.challenge_id
+                return client.last_context
+        finally:
+            await server.stop(None)
+
+    last_ctx = run(main())
+
+    # two attempts hit the wire, same trace id, attempt bumped
+    assert len(seen) == 2
+    (tid1, a1), (tid2, a2) = seen
+    assert tid1 and tid1 == tid2
+    assert (a1, a2) == ("1", "2")
+    assert last_ctx is not None
+    assert last_ctx.trace_id == tid1 and last_ctx.attempt == 2
+
+    # server-side ring: the successful attempt completed under the same
+    # trace id with the retried attempt number
+    challenge_traces = [
+        t for t in tracer.completed()
+        if t.name == "CreateChallenge" and t.trace_id == tid1
+    ]
+    assert challenge_traces
+    assert challenge_traces[-1].attempt == 2
+    assert challenge_traces[-1].status == "success"
+
+
+def test_failure_paths_count_and_observe():
+    """Early-abort paths count a failure AND observe the duration
+    histogram (the boilerplate they used to skip)."""
+    async def main():
+        state = ServerState()
+        server, port = await serve(
+            state, RateLimiter(10_000, 10_000), host="127.0.0.1", port=0
+        )
+        before_fail = metrics.read("auth.challenge.failure")
+        before_obs = metrics.read_histogram("auth.challenge.duration")[0]
+        before_labeled = metrics.read(
+            "rpc.requests",
+            labels={"rpc": "CreateChallenge", "outcome": "failure"},
+        )
+        try:
+            async with AuthClient(f"127.0.0.1:{port}") as client:
+                with pytest.raises(grpc.RpcError):
+                    await client.create_challenge("ghost-user")
+        finally:
+            await server.stop(None)
+        return before_fail, before_obs, before_labeled
+
+    before_fail, before_obs, before_labeled = run(main())
+    assert metrics.read("auth.challenge.failure") == before_fail + 1
+    assert metrics.read_histogram("auth.challenge.duration")[0] == before_obs + 1
+    assert metrics.read(
+        "rpc.requests", labels={"rpc": "CreateChallenge", "outcome": "failure"}
+    ) == before_labeled + 1
+
+    failed = [t for t in get_tracer().completed() if t.status == "failure"]
+    assert any(t.name == "CreateChallenge" for t in failed)
+
+
+# --- tracer unit behavior ----------------------------------------------------
+
+
+def test_tracer_ring_capacity_and_find():
+    tracer = get_tracer()
+    tracer.configure(capacity=4)
+    try:
+        for i in range(10):
+            ctx = RequestContext()
+            tracer.start(ctx, f"op{i}")
+            tracer.finish(ctx.trace_id, "success")
+        completed = tracer.completed()
+        assert len(completed) == 4
+        assert [t.name for t in completed] == ["op6", "op7", "op8", "op9"]
+        assert tracer.find(completed[-1].trace_id) == [completed[-1]]
+    finally:
+        tracer.configure(capacity=256)
+
+
+def test_tracer_span_on_unknown_trace_is_dropped():
+    tracer = get_tracer()
+    tracer.add_span("no-such-trace", "queue_wait", 0.0, 1.0)
+    tracer.add_span(None, "queue_wait", 0.0, 1.0)
+    assert tracer.completed() == []
+
+
+def test_format_tracez_empty_and_limit():
+    assert "no completed traces" in format_tracez([])
+    tracer = get_tracer()
+    for i in range(5):
+        ctx = RequestContext()
+        tracer.start(ctx, f"op{i}")
+        tracer.finish(ctx.trace_id, "success")
+    out = format_tracez(tracer.completed(), limit=2)
+    assert "op4" in out and "op3" in out and "op2" not in out
+
+
+def test_breaker_transition_lands_in_trace_ring():
+    """CLOSED→OPEN (and recovery) breaker flips are visible on the same
+    timeline as request traces."""
+
+    class Broken(VerifierBackend):
+        prefers_combined = True
+
+        def verify_combined(self, rows, beta):
+            raise RuntimeError("injected device loss")
+
+        def verify_each(self, rows):
+            raise RuntimeError("injected device loss")
+
+    rng = SecureRng()
+    params = Parameters.new()
+    prover = Prover(params, Witness(Ristretto255.random_scalar(rng)))
+    proof = prover.prove_with_transcript(rng, Transcript())
+
+    backend = FailoverBackend(Broken(), CpuBackend())
+    bv = BatchVerifier(backend=backend)
+    bv.add(params, prover.statement, proof)
+    bv.add(params, prover.statement, proof)
+    assert bv.verify(rng) == [None, None]
+    assert backend.degraded
+
+    events = [
+        t for t in get_tracer().completed() if t.name == "breaker_transition"
+    ]
+    assert events
+    attrs = events[-1].spans[0].attrs
+    assert (attrs["old"], attrs["new"]) == ("closed", "open")
+    assert events[-1].status == "event"
+
+
+# --- context plumbing --------------------------------------------------------
+
+
+def test_request_context_metadata_roundtrip():
+    ctx = RequestContext(attempt=3, parent_span="abcd")
+    md = ctx.to_metadata()
+    back = RequestContext.from_metadata(md, deadline=12.5)
+    assert back.trace_id == ctx.trace_id
+    assert back.attempt == 3
+    assert back.parent_span == "abcd"
+    assert back.deadline == 12.5
+
+
+def test_request_context_tolerates_garbage_metadata():
+    back = RequestContext.from_metadata(
+        [(TRACE_ID_KEY, ""), (ATTEMPT_KEY, "not-a-number")]
+    )
+    assert back.trace_id  # freshly minted
+    assert back.attempt == 1
+    assert RequestContext.from_metadata(None).trace_id
+
+
+def test_json_formatter_pulls_contextvar_trace_id():
+    ctx = RequestContext()
+    token = current_context.set(ctx)
+    try:
+        record = logging.LogRecord(
+            "test", logging.INFO, __file__, 1, "hello %s", ("world",), None
+        )
+        data = json.loads(JsonLogFormatter().format(record))
+    finally:
+        current_context.reset(token)
+    assert data["message"] == "hello world"
+    assert data["trace_id"] == ctx.trace_id
+    assert data["level"] == "INFO"
+    assert data["logger"] == "test"
+
+
+# --- metrics facade ----------------------------------------------------------
+
+
+def test_noop_metric_observe_accumulates():
+    from cpzk_tpu.server.metrics import _NoopMetric
+
+    m = _NoopMetric()
+    m.observe(0.5)
+    m.observe(1.5)
+    assert m._count.get() == 2.0
+    assert m._sum.get() == 2.0
+
+
+def test_noop_metric_labeled_children():
+    from cpzk_tpu.server.metrics import _NoopMetric
+
+    fam = _NoopMetric(("rpc", "outcome"))
+    fam.labels(rpc="X", outcome="success").inc()
+    fam.labels(rpc="X", outcome="success").inc(2)
+    fam.labels(rpc="Y", outcome="failure").inc()
+    assert fam.labels(rpc="X", outcome="success")._value.get() == 3.0
+    assert fam.labels(rpc="Y", outcome="failure")._value.get() == 1.0
+
+
+def test_histogram_read_count_and_sum():
+    h = metrics.histogram("obs.test.hist")
+    h.observe(0.25)
+    h.observe(0.75)
+    count, total = metrics.read_histogram("obs.test.hist")
+    assert count == 2.0
+    assert total == pytest.approx(1.0)
+    assert metrics.read("obs.test.hist", "h") == pytest.approx(1.0)
+    assert metrics.read_histogram("obs.test.never.created") == (0.0, 0.0)
+
+
+def test_registered_inventory_lists_kinds():
+    metrics.counter("obs.test.reg.counter").inc()
+    metrics.gauge("obs.test.reg.gauge").set(1)
+    pairs = metrics.registered()
+    assert ("c", "obs.test.reg.counter") in pairs
+    assert ("g", "obs.test.reg.gauge") in pairs
+
+
+# --- config ------------------------------------------------------------------
+
+
+def test_observability_config_env(monkeypatch):
+    monkeypatch.setenv("SERVER_OBSERVABILITY_JSON_LOGS", "true")
+    monkeypatch.setenv("SERVER_OBS_SLOW_REQUEST_MS", "250")
+    monkeypatch.setenv("SERVER_OBSERVABILITY_TRACE_RING", "32")
+    monkeypatch.setenv("SERVER_OBS_LATENCY_BUCKETS_MS", "1, 5, 10")
+    cfg = ServerConfig()
+    cfg._merge_env()
+    assert cfg.observability.json_logs is True
+    assert cfg.observability.slow_request_ms == 250.0
+    assert cfg.observability.trace_ring == 32
+    assert cfg.observability.parsed_buckets() == [0.001, 0.005, 0.01]
+    cfg.validate()
+
+
+def test_observability_config_validation():
+    cfg = ServerConfig()
+    cfg.observability.trace_ring = 0
+    with pytest.raises(ValueError):
+        cfg.validate()
+    cfg = ServerConfig()
+    cfg.observability.slow_request_ms = -5
+    with pytest.raises(ValueError):
+        cfg.validate()
+    cfg = ServerConfig()
+    cfg.observability.latency_buckets_ms = "10,5,1"
+    with pytest.raises(ValueError):
+        cfg.validate()
+    cfg = ServerConfig()
+    cfg.observability.latency_buckets_ms = "abc"
+    with pytest.raises(ValueError):
+        cfg.validate()
+
+
+def test_configure_applies_settings():
+    from cpzk_tpu.observability import configure
+    from cpzk_tpu.server.config import ObservabilitySettings
+
+    tracer = get_tracer()
+    try:
+        configure(ObservabilitySettings(slow_request_ms=-1, trace_ring=8))
+        assert tracer.slow_request_s is None
+        configure(ObservabilitySettings(slow_request_ms=500, trace_ring=8))
+        assert tracer.slow_request_s == 0.5
+    finally:
+        tracer.configure(capacity=256, slow_request_s=1.0)
+
+
+# --- /tracez command ---------------------------------------------------------
+
+
+def test_tracez_command_empty_and_bad_arg():
+    async def main():
+        state = ServerState()
+        out_empty, _ = await handle_command("/tracez", state)
+        out_bad, _ = await handle_command("/tracez banana", state)
+        return out_empty, out_bad
+
+    out_empty, out_bad = run(main())
+    assert "no completed traces" in out_empty
+    assert "usage: /tracez" in out_bad
