@@ -1,0 +1,50 @@
+"""Crash-consistent durability: write-ahead log + bounded-loss recovery.
+
+Upgrades the opt-in snapshot persistence (``--state-file``) to the
+contract every production serving stack provides — an acknowledged write
+survives a crash, recovery is automatic and bounded, and the failure
+modes are exercised by deterministic tests:
+
+- :mod:`.wal` — :class:`WriteAheadLog`: length- and CRC32-framed JSON
+  records (``register_user`` / ``create_session`` / ``revoke_session`` /
+  ``expire_sessions``) with a configurable fsync policy and atomic-rename
+  compaction, plus the deterministic crash points the fault harness
+  schedules;
+- :mod:`.recovery` — boot: snapshot load with corrupt-file quarantine,
+  torn-tail truncation, and WAL-suffix replay through the same
+  trust-boundary validators live RPCs pass;
+- :mod:`.manager` — :class:`DurabilityManager`: the lifecycle object the
+  daemon drives (recover → checkpoint-per-sweep → close-on-shutdown).
+
+Configuration lives in the ``[durability]`` section of the server config
+(``SERVER_DURABILITY_*`` env); the operator story is documented in
+``docs/operations.md`` §"Durability & recovery".
+"""
+
+from __future__ import annotations
+
+from .manager import DurabilityManager
+from .recovery import RecoveryReport, quarantine_file, recover_state
+from .wal import (
+    MAX_FRAME_PAYLOAD,
+    WAL_CRASH_POINTS,
+    CrashPoint,
+    WriteAheadLog,
+    encode_record,
+    iter_frames,
+    read_frames,
+)
+
+__all__ = [
+    "CrashPoint",
+    "DurabilityManager",
+    "MAX_FRAME_PAYLOAD",
+    "RecoveryReport",
+    "WAL_CRASH_POINTS",
+    "WriteAheadLog",
+    "encode_record",
+    "iter_frames",
+    "quarantine_file",
+    "read_frames",
+    "recover_state",
+]

@@ -1,0 +1,152 @@
+"""DurabilityManager: the snapshot + WAL pair as one serving-stack unit.
+
+Owns the whole persistence lifecycle the daemon wires up when
+``[durability] enabled = true``:
+
+- :meth:`recover` — boot: snapshot load (quarantine-safe), torn-tail
+  truncation, WAL-suffix replay, then opens the log for append and
+  attaches it to ``ServerState`` as the journal hook;
+- :meth:`checkpoint` — each cleanup sweep: snapshot (which embeds the
+  covered WAL sequence number), opportunistic interval-policy fsync, and
+  log compaction once the WAL outgrows ``compact_bytes``;
+- :meth:`close` — graceful shutdown: final snapshot, then truncate the
+  fully-covered log so the next boot replays nothing.
+
+Compaction never loses data: the snapshot write captures the WAL byte
+offset it covers (under the state lock, so it is exact), and compaction
+drops only that prefix — records appended after the snapshot survive the
+rename and remain the replay suffix.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+
+from ..observability import get_tracer
+from ..server import metrics
+from .recovery import RecoveryReport, recover_state
+from .wal import WriteAheadLog
+
+log = logging.getLogger("cpzk_tpu.durability")
+
+
+class DurabilityManager:
+    """Wire a :class:`WriteAheadLog` + snapshot pair to a ``ServerState``."""
+
+    def __init__(self, state, settings, state_file: str, faults=None):
+        if not state_file:
+            raise ValueError("durability requires a state_file")
+        self.state = state
+        self.settings = settings
+        self.state_file = state_file
+        self.wal_path = settings.wal_path or state_file + ".wal"
+        self.faults = faults
+        self.wal: WriteAheadLog | None = None
+        self.report: RecoveryReport | None = None
+        self.covered_seq = 0
+        self._covered_offset = 0
+        self._last_snapshot_wall: float | None = None
+
+    # -- lifecycle -----------------------------------------------------------
+
+    async def recover(self) -> RecoveryReport:
+        """Boot-time recovery, then open the WAL for append and attach it
+        as the state's journal hook.  Call exactly once, before serving."""
+        report = await recover_state(self.state, self.state_file, self.wal_path)
+        self.report = report
+        self.covered_seq = report.covered_seq
+        # Conservative: the byte offset the last snapshot covers inside the
+        # (possibly pre-existing) log is unknown until this process writes
+        # a snapshot of its own — until then, compaction keeps everything.
+        self._covered_offset = 0
+        self.wal = WriteAheadLog(
+            self.wal_path,
+            fsync=self.settings.fsync,
+            fsync_interval_ms=self.settings.fsync_interval_ms,
+            start_seq=report.next_seq,
+            faults=self.faults,
+        )
+        self.state.attach_journal(self.wal)
+        return report
+
+    async def checkpoint(self) -> bool:
+        """One sweep's persistence work: snapshot when dirty, fsync an
+        interval-policy log that is due, compact a log the snapshot now
+        mostly covers.  Returns whether a snapshot was written."""
+        wrote = await self.state.snapshot(self.state_file)
+        if wrote:
+            self.covered_seq = self.state.snapshot_covered_seq
+            self._covered_offset = self.state.snapshot_covered_offset
+            self._last_snapshot_wall = time.time()
+        if self.wal is not None and self.wal.needs_sync():
+            await asyncio.to_thread(self.wal.sync)
+        if (
+            self.wal is not None
+            and self._covered_offset > 0
+            and self.wal.size > self.settings.compact_bytes
+        ):
+            freed = await asyncio.to_thread(self.wal.compact, self._covered_offset)
+            self._covered_offset = 0
+            if freed:
+                get_tracer().record_event(
+                    "wal_compaction",
+                    freed_bytes=freed,
+                    covered_seq=self.covered_seq,
+                    wal_bytes=self.wal.size,
+                )
+                log.info(
+                    "WAL compaction: dropped %d covered bytes (<= seq %d), "
+                    "%d bytes remain", freed, self.covered_seq, self.wal.size,
+                )
+        self._update_snapshot_age()
+        return wrote
+
+    async def close(self) -> None:
+        """Graceful shutdown: final snapshot, truncate the fully-covered
+        log, release the fd.  After this a reboot restores from the
+        snapshot alone and replays nothing."""
+        if self.wal is None:
+            return
+        wrote = await self.state.snapshot(self.state_file)
+        if wrote:
+            self.covered_seq = self.state.snapshot_covered_seq
+            self._covered_offset = self.state.snapshot_covered_offset
+            self._last_snapshot_wall = time.time()
+        # Clean state means the last snapshot already covers every record
+        # (every journaled mutation also dirties the snapshot flag), so
+        # covered_seq == wal.seq here on both branches.
+        if self.covered_seq == self.wal.seq and self.wal.size > 0:
+            await asyncio.to_thread(self.wal.compact, self.wal.size)
+            self._covered_offset = 0
+        await asyncio.to_thread(self.wal.close)
+        self._update_snapshot_age()
+
+    # -- inspection ----------------------------------------------------------
+
+    def _update_snapshot_age(self) -> None:
+        if self._last_snapshot_wall is not None:
+            metrics.gauge("state.snapshot.age_seconds").set(
+                max(0.0, time.time() - self._last_snapshot_wall)
+            )
+
+    def status(self) -> dict:
+        """The admin REPL ``/persist`` payload."""
+        wal = self.wal
+        return {
+            "wal_path": self.wal_path,
+            "wal_bytes": wal.size if wal is not None else 0,
+            "wal_seq": wal.seq if wal is not None else 0,
+            "covered_seq": self.covered_seq,
+            "pending_appends": wal.pending if wal is not None else 0,
+            "fsync_policy": self.settings.fsync,
+            "last_fsync_age_s": (
+                wal.last_fsync_age_s if wal is not None else float("inf")
+            ),
+            "snapshot_age_s": (
+                time.time() - self._last_snapshot_wall
+                if self._last_snapshot_wall is not None
+                else None
+            ),
+        }

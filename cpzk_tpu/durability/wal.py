@@ -1,0 +1,306 @@
+"""Write-ahead log: length- and CRC32-framed JSON records on a raw fd.
+
+The durability contract the snapshot-only persistence cannot give: an
+acknowledged mutation survives a crash *between* snapshot sweeps.  Every
+acknowledged change to persisted state (``register_user``,
+``create_session``, ``revoke_session``, ``expire_sessions``) is appended
+here before the RPC returns; boot-time recovery replays the suffix past
+the last snapshot's covered sequence number (see :mod:`.recovery`).
+
+Frame format (all integers big-endian)::
+
+    +----------------+----------------+------------------------+
+    | length  u32    | crc32   u32    | payload (JSON, length) |
+    +----------------+----------------+------------------------+
+
+The CRC covers the payload only; the payload is one JSON object with at
+least ``{"seq": <monotonic int>, "type": <str>}``.  A reader accepts the
+longest prefix of well-formed frames with strictly increasing sequence
+numbers and stops at the first violation — a torn tail (the crash left a
+partial frame) and mid-log corruption are therefore indistinguishable by
+construction, and neither can ever make a partially-written record
+visible to replay.
+
+Fsync policy (``durability.fsync``):
+
+- ``always``   — fsync before the mutation is acknowledged (loss window:
+  none for acknowledged writes).
+- ``interval`` — fsync at most every ``fsync_interval_ms``, piggybacked
+  on appends and forced by the periodic sweep (loss window: about one
+  interval of acknowledged writes).
+- ``off``      — never fsync explicitly; the OS page cache decides
+  (loss window: everything since the kernel's last writeback).
+
+Appends go through ``os.write`` on an ``O_APPEND`` fd (no user-space
+buffer), so ``size`` always reflects what a crashed process left in the
+file.  The file is created 0600 and re-chmodded defensively: session
+records hold live bearer tokens, the same protection requirement as the
+snapshot.
+
+Deterministic crash points (``pre_append`` / ``mid_frame`` /
+``post_append_pre_fsync`` / ``pre_rename``) are consulted on a
+:class:`~cpzk_tpu.resilience.faults.FaultPlan` passed as ``faults`` —
+each raises :class:`CrashPoint` at exactly the file state a process
+death at that instruction would leave, so the recovery tests assert
+exact outcomes instead of sampling kill timing.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import struct
+import tempfile
+import threading
+import time
+import zlib
+
+from ..server import metrics
+
+_HEADER = struct.Struct(">II")
+HEADER_BYTES = _HEADER.size
+
+#: Sanity cap on one frame's payload: a garbage length field must not make
+#: the reader allocate gigabytes (largest real record is a register_user
+#: at a few hundred bytes).
+MAX_FRAME_PAYLOAD = 1 << 20
+
+#: The deterministic crash sites a FaultPlan can schedule (see
+#: ``FaultPlan.crash_on``); occurrence indexes count per-site visits.
+WAL_CRASH_POINTS = (
+    "pre_append",            # nothing written for this record
+    "mid_frame",             # half the frame written: a torn tail on disk
+    "post_append_pre_fsync",  # full frame written, never fsynced
+    "pre_rename",            # compaction tmp written, rename never happened
+)
+
+
+class CrashPoint(RuntimeError):
+    """Deterministic injected crash at a WAL write site — stands in for the
+    process dying at exactly that instruction (the SIGKILL subprocess test
+    does it for real)."""
+
+
+def encode_record(rec: dict) -> bytes:
+    """One framed record: compact, key-sorted JSON behind length + CRC32."""
+    payload = json.dumps(rec, separators=(",", ":"), sort_keys=True).encode()
+    if len(payload) > MAX_FRAME_PAYLOAD:
+        raise ValueError(f"WAL record exceeds {MAX_FRAME_PAYLOAD} bytes")
+    return _HEADER.pack(len(payload), zlib.crc32(payload) & 0xFFFFFFFF) + payload
+
+
+def iter_frames(buf: bytes) -> tuple[list[dict], int]:
+    """``(records, valid_bytes)``: the longest well-formed prefix of ``buf``.
+
+    Stops at the first short header, oversized/zero length field, CRC
+    mismatch, non-JSON payload, schema violation (missing ``seq``/``type``),
+    or non-increasing sequence number.  ``valid_bytes`` is the byte offset
+    the file should be truncated to; everything past it is a torn tail or
+    corruption and is never surfaced as a record.
+    """
+    out: list[dict] = []
+    off = 0
+    n = len(buf)
+    prev_seq = None
+    while n - off >= HEADER_BYTES:
+        length, crc = _HEADER.unpack_from(buf, off)
+        if length == 0 or length > MAX_FRAME_PAYLOAD:
+            break
+        end = off + HEADER_BYTES + length
+        if end > n:
+            break  # torn tail: the frame was cut mid-write
+        payload = bytes(buf[off + HEADER_BYTES:end])
+        if zlib.crc32(payload) & 0xFFFFFFFF != crc:
+            break
+        try:
+            rec = json.loads(payload)
+        except ValueError:
+            break
+        if (
+            not isinstance(rec, dict)
+            or not isinstance(rec.get("seq"), int)
+            or isinstance(rec.get("seq"), bool)
+            or not isinstance(rec.get("type"), str)
+        ):
+            break
+        if prev_seq is not None and rec["seq"] <= prev_seq:
+            break
+        prev_seq = rec["seq"]
+        out.append(rec)
+        off = end
+    return out, off
+
+
+def read_frames(path: str) -> tuple[list[dict], int, int]:
+    """``(records, valid_bytes, file_bytes)`` for the log at ``path``."""
+    with open(path, "rb") as f:
+        raw = f.read()
+    records, valid = iter_frames(raw)
+    return records, valid, len(raw)
+
+
+class WriteAheadLog:
+    """Append-only framed-record log with a configurable fsync policy.
+
+    ``append`` is synchronous and cheap (one ``os.write`` into the page
+    cache) so :class:`~cpzk_tpu.server.state.ServerState` can call it
+    under its state lock — WAL order then always matches in-memory
+    application order.  The fsync (when the policy wants one) happens in
+    :meth:`sync`, which callers run on a worker thread *after* releasing
+    the lock but *before* acknowledging the mutation; fsync flushes every
+    earlier write too, so per-record durability still holds under
+    interleaving.
+
+    A threading lock guards the fd: appends come from the event loop,
+    ``sync`` and :meth:`compact` from worker threads.
+    """
+
+    def __init__(
+        self,
+        path: str,
+        fsync: str = "always",
+        fsync_interval_ms: float = 50.0,
+        start_seq: int = 0,
+        faults=None,
+    ):
+        if fsync not in ("always", "interval", "off"):
+            raise ValueError(f"unknown WAL fsync policy: {fsync!r}")
+        self.path = path
+        self.policy = fsync
+        self.interval_s = fsync_interval_ms / 1000.0
+        self.seq = start_seq
+        self._faults = faults
+        self._lock = threading.Lock()
+        self._fd: int | None = os.open(
+            path, os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o600
+        )
+        os.chmod(path, 0o600)  # session records are bearer secrets
+        self.size = os.fstat(self._fd).st_size
+        self._pending = 0  # appends since the last fsync
+        self._last_fsync = time.monotonic()
+
+    # -- append / sync -------------------------------------------------------
+
+    def _crash(self, point: str) -> bool:
+        return self._faults is not None and self._faults.take_crash(point)
+
+    def append(self, rtype: str, payload: dict) -> int:
+        """Frame and write one record; returns its sequence number.  The
+        record is in the OS page cache after this returns — call
+        :meth:`sync` before acknowledging when the policy demands it."""
+        with self._lock:
+            if self._fd is None:
+                raise OSError("write-ahead log is closed")
+            seq = self.seq + 1
+            rec = {"seq": seq, "type": rtype}
+            rec.update(payload)
+            frame = encode_record(rec)
+            if self._crash("pre_append"):
+                raise CrashPoint(f"pre_append at seq {seq}")
+            if self._crash("mid_frame"):
+                cut = max(1, len(frame) // 2)
+                os.write(self._fd, frame[:cut])
+                self.size += cut
+                raise CrashPoint(f"mid_frame at seq {seq}")
+            os.write(self._fd, frame)
+            self.seq = seq
+            self.size += len(frame)
+            self._pending += 1
+            metrics.counter("state.wal.appends").inc()
+            metrics.counter("state.wal.bytes").inc(len(frame))
+            if self._crash("post_append_pre_fsync"):
+                raise CrashPoint(f"post_append_pre_fsync at seq {seq}")
+            return seq
+
+    def needs_sync(self) -> bool:
+        """Whether :meth:`sync` would fsync right now under the policy —
+        lets the async caller skip the worker-thread hop entirely."""
+        if self._pending == 0 or self.policy == "off":
+            return False
+        if self.policy == "always":
+            return True
+        return time.monotonic() - self._last_fsync >= self.interval_s
+
+    def sync(self, force: bool = False) -> bool:
+        """Fsync pending appends per the policy (``force`` overrides it);
+        returns whether an fsync happened."""
+        with self._lock:
+            if self._fd is None or self._pending == 0:
+                return False
+            if not force:
+                if self.policy == "off":
+                    return False
+                if (
+                    self.policy == "interval"
+                    and time.monotonic() - self._last_fsync < self.interval_s
+                ):
+                    return False
+            os.fsync(self._fd)
+            self._pending = 0
+            self._last_fsync = time.monotonic()
+            metrics.counter("state.wal.fsyncs").inc()
+            return True
+
+    @property
+    def last_fsync_age_s(self) -> float:
+        """Seconds since the last fsync (or since open, if none yet)."""
+        return max(0.0, time.monotonic() - self._last_fsync)
+
+    @property
+    def pending(self) -> int:
+        return self._pending
+
+    # -- compaction ----------------------------------------------------------
+
+    def compact(self, upto_offset: int) -> int:
+        """Drop the byte prefix a snapshot now covers: copy ``[upto_offset,
+        EOF)`` to a 0600 tmp file, fsync it, and atomically rename it over
+        the log.  Returns bytes freed.  Runs under the fd lock, so
+        concurrent appends briefly queue; the copied tail is bounded by the
+        compaction threshold, keeping the stall small.  A crash before the
+        rename (``pre_rename`` crash point, or a real one) leaves the old
+        log fully intact — compaction is all-or-nothing."""
+        with self._lock:
+            if self._fd is None:
+                raise OSError("write-ahead log is closed")
+            upto = max(0, min(upto_offset, self.size))
+            if upto == 0:
+                return 0
+            with open(self.path, "rb") as f:
+                f.seek(upto)
+                tail = f.read()
+            d = os.path.dirname(os.path.abspath(self.path)) or "."
+            prefix = "." + os.path.basename(self.path) + ".compact."
+            fd, tmp = tempfile.mkstemp(prefix=prefix, dir=d)  # 0600
+            try:
+                if tail:
+                    os.write(fd, tail)
+                os.fsync(fd)
+                os.close(fd)
+                if self._crash("pre_rename"):
+                    raise CrashPoint("pre_rename during WAL compaction")
+                os.replace(tmp, self.path)
+            except BaseException:
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
+                raise
+            os.close(self._fd)
+            self._fd = os.open(
+                self.path, os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o600
+            )
+            freed = self.size - len(tail)
+            self.size = len(tail)
+            self._pending = 0  # the tmp copy was fsynced before the rename
+            return freed
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def close(self) -> None:
+        """Force-sync pending appends and release the fd (idempotent)."""
+        self.sync(force=True)
+        with self._lock:
+            if self._fd is not None:
+                os.close(self._fd)
+                self._fd = None

@@ -29,15 +29,24 @@ __all__ = [
     "CircuitBreaker",
     "RetryBudget",
     "RetryPolicy",
+    "CrashPoint",
     "FaultPlan",
     "FaultInjectionBackend",
     "InjectedFault",
     "SnapshotFaults",
+    "WAL_CRASH_POINTS",
 ]
 
 
 def __getattr__(name: str):
-    if name in ("FaultPlan", "FaultInjectionBackend", "InjectedFault", "SnapshotFaults"):
+    if name in (
+        "CrashPoint",
+        "FaultPlan",
+        "FaultInjectionBackend",
+        "InjectedFault",
+        "SnapshotFaults",
+        "WAL_CRASH_POINTS",
+    ):
         from . import faults
 
         return getattr(faults, name)

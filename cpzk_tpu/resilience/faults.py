@@ -5,10 +5,15 @@ faults; :class:`FaultInjectionBackend` applies it to any
 :class:`~cpzk_tpu.protocol.batch.VerifierBackend` (raise-after-N-batches,
 intermittent flapping, per-batch latency spikes), and
 :class:`SnapshotFaults` injects ``OSError`` mid-``write()`` into
-:meth:`~cpzk_tpu.server.state.ServerState.snapshot`.  Everything is
-reproducible from the plan alone — same plan, same faults, same batch
-indexes — so chaos tests (``tests/test_chaos.py``) assert exact outcomes
-instead of sampling flaky timing windows.
+:meth:`~cpzk_tpu.server.state.ServerState.snapshot`, and the WAL crash
+points (:meth:`FaultPlan.crash_on`) schedule deterministic process-death
+stand-ins at exact write sites inside
+:class:`~cpzk_tpu.durability.wal.WriteAheadLog` (``pre_append`` /
+``mid_frame`` / ``post_append_pre_fsync`` / ``pre_rename``).  Everything
+is reproducible from the plan alone — same plan, same faults, same batch
+indexes — so chaos tests (``tests/test_chaos.py``) and the durability
+suite (``tests/test_durability.py``) assert exact outcomes instead of
+sampling flaky timing windows.
 
 Example::
 
@@ -28,6 +33,7 @@ import random
 import threading
 import time
 
+from ..durability.wal import WAL_CRASH_POINTS, CrashPoint  # noqa: F401 (re-export)
 from ..protocol.batch import VerifierBackend
 
 
@@ -54,6 +60,10 @@ class FaultPlan:
         self._latency_every = 0
         self._snapshot_errors = 0
         self._snapshot_lock = threading.Lock()
+        # WAL crash points: site -> scheduled occurrence indexes, and the
+        # per-site visit counters (shared lock with the snapshot budget)
+        self._crash_points: dict[str, set[int]] = {}
+        self._crash_seen: dict[str, int] = {}
 
     # -- builders ----------------------------------------------------------
 
@@ -102,6 +112,23 @@ class FaultPlan:
         self._snapshot_errors = n
         return self
 
+    def crash_on(self, point: str, occurrence: int = 0) -> "FaultPlan":
+        """Schedule a :class:`CrashPoint` at the ``occurrence``-th visit of
+        a WAL crash site (``pre_append`` / ``mid_frame`` /
+        ``post_append_pre_fsync`` count once per append, in that order;
+        ``pre_rename`` once per compaction) — the deterministic stand-in
+        for the process dying at exactly that instruction.  Pass the plan
+        as ``WriteAheadLog(..., faults=plan)`` (or via
+        ``DurabilityManager(..., faults=plan)``) to arm it."""
+        if point not in WAL_CRASH_POINTS:
+            raise ValueError(
+                f"unknown crash point {point!r}; one of {WAL_CRASH_POINTS}"
+            )
+        if occurrence < 0:
+            raise ValueError("crash_on occurrence must be >= 0")
+        self._crash_points.setdefault(point, set()).add(occurrence)
+        return self
+
     # -- queries -----------------------------------------------------------
 
     def should_fail(self, batch_index: int) -> bool:
@@ -129,6 +156,14 @@ class FaultPlan:
                 return False
             self._snapshot_errors -= 1
             return True
+
+    def take_crash(self, point: str) -> bool:
+        """Visit one WAL crash site: bump its occurrence counter and report
+        whether this visit was scheduled by :meth:`crash_on`."""
+        with self._snapshot_lock:
+            i = self._crash_seen.get(point, 0)
+            self._crash_seen[point] = i + 1
+            return i in self._crash_points.get(point, ())
 
     def _roll(self, key: int) -> float:
         return random.Random(f"{self.seed}:{key}").random()

@@ -3,9 +3,12 @@
 Flags (env-overridable like the clap definitions at server.rs:20-48), config
 load + validation, background cleanup task under a panic-restarting
 supervisor, optional Prometheus exporter, gRPC health, a colored admin REPL
-(/status /users /sessions /challenges /cleanup /help /quit), and graceful
-shutdown: health flips to NOT_SERVING, 2 s drain, then the listener stops
-(server.rs:379-427).
+(/status /persist /users /sessions /challenges /cleanup /help /quit), and
+graceful shutdown: health flips to NOT_SERVING, 2 s drain, the listener
+stops, background tasks are awaited, and the final snapshot lands
+(server.rs:379-427).  Boot goes through :func:`load_state`: crash recovery
+(snapshot + WAL replay) when ``[durability]`` is enabled, quarantine-safe
+snapshot restore otherwise.
 
 Run: ``python -m cpzk_tpu.server --host 127.0.0.1 --port 50051``
 """
@@ -14,10 +17,12 @@ from __future__ import annotations
 
 import argparse
 import asyncio
+import contextlib
 import logging
 import os
 import signal
 import sys
+import time
 
 from . import metrics
 from .config import RateLimiter, ServerConfig
@@ -104,10 +109,15 @@ def build_backend(config):
 
 
 async def cleanup_supervisor(
-    state: ServerState, stop: asyncio.Event, state_file: str | None = None
+    state: ServerState,
+    stop: asyncio.Event,
+    state_file: str | None = None,
+    durability=None,
 ) -> None:
     """Periodic expiry sweeps under a restart-on-crash supervisor
-    (server.rs:168-192); with --state-file, each sweep also checkpoints."""
+    (server.rs:168-192); with --state-file, each sweep also checkpoints —
+    through the :class:`~cpzk_tpu.durability.DurabilityManager` (snapshot
+    + WAL fsync/compaction) when durability is enabled."""
 
     async def sweep_loop():
         while not stop.is_set():
@@ -120,7 +130,9 @@ async def cleanup_supervisor(
             ns = await state.cleanup_expired_sessions()
             if nc or ns:
                 log.info("cleanup: %d challenges, %d sessions expired", nc, ns)
-            if state_file:
+            if durability is not None:
+                await durability.checkpoint()
+            elif state_file:
                 await state.snapshot(state_file)
 
     while not stop.is_set():
@@ -140,6 +152,7 @@ async def cleanup_supervisor(
 HELP = """Available commands:
   /status      (/st)  server status summary (incl. backend breaker state)
   /tracez [N]  (/tz)  last N completed request traces w/ stage breakdown
+  /persist     (/wal) durability status: WAL size, fsync age, covered seq
   /users       (/u)   registered user count
   /sessions    (/s)   active session count
   /challenges  (/c)   pending challenge count
@@ -150,11 +163,13 @@ HELP = """Available commands:
 
 
 async def handle_command(
-    cmd: str, state: ServerState, backend=None
+    cmd: str, state: ServerState, backend=None, durability=None
 ) -> tuple[str, bool]:
     """(output, should_quit) for one REPL line (server.rs:50-90,261-359).
     ``backend`` is the serving FailoverBackend (None on the inline CPU
-    path) — /status surfaces its breaker state, /reset re-arms it."""
+    path) — /status surfaces its breaker state, /reset re-arms it;
+    ``durability`` is the DurabilityManager behind /persist (None when
+    durability is disabled)."""
     cmd = cmd.strip()
     if not cmd:
         return "", False
@@ -184,6 +199,23 @@ async def handle_command(
         except ValueError:
             return f"usage: /tracez [N] — not a number: {parts[1]}", False
         return format_tracez(get_tracer().completed(), limit=max(1, limit)), False
+    if word in ("/persist", "/wal"):
+        if durability is None or durability.wal is None:
+            return (
+                "durability disabled (set [durability] enabled = true and a "
+                "state_file to get a write-ahead log)",
+                False,
+            )
+        s = durability.status()
+        age = s["snapshot_age_s"]
+        return (
+            f"wal={s['wal_path']} bytes={s['wal_bytes']} seq={s['wal_seq']}"
+            f" covered_seq={s['covered_seq']} pending={s['pending_appends']}"
+            f" fsync={s['fsync_policy']}"
+            f" last_fsync_age={s['last_fsync_age_s']:.1f}s"
+            f" snapshot_age={'n/a' if age is None else f'{age:.1f}s'}",
+            False,
+        )
     if word in ("/reset", "/rearm"):
         if backend is None or not hasattr(backend, "breaker"):
             return "no failover backend to reset (inline CPU path)", False
@@ -204,6 +236,49 @@ async def handle_command(
     if word in ("/quit", "/exit", "/q"):
         return "shutting down...", True
     return f"Unknown command: {word}. Type /help for available commands.", False
+
+
+async def load_state(config: ServerConfig):
+    """(state, durability manager | None) for the resolved config.
+
+    With ``[durability] enabled``: full crash recovery — snapshot load with
+    corrupt-file quarantine, WAL torn-tail truncation + suffix replay, then
+    a fresh covering snapshot so the next boot replays nothing.  Without
+    it: the plain snapshot restore, where a corrupt snapshot quarantines
+    with a loud ERROR and the server boots empty instead of crash-looping
+    on every restart."""
+    state = ServerState()
+    if config.durability.enabled:
+        from ..durability import DurabilityManager
+
+        durability = DurabilityManager(state, config.durability, config.state_file)
+        report = await durability.recover()
+        log.info(
+            "durability: %d users / %d sessions from snapshot, %d WAL records "
+            "replayed (%d skipped) up to seq %d",
+            report.users, report.sessions, report.replayed, report.skipped,
+            report.next_seq,
+        )
+        # fold the replayed suffix into a fresh covering snapshot now:
+        # bounds the next boot's replay and arms compaction
+        await durability.checkpoint()
+        return state, durability
+    if config.state_file and os.path.exists(config.state_file):
+        try:
+            nu, ns = await state.restore(config.state_file)
+            log.info("restored state snapshot: %d users, %d sessions", nu, ns)
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:
+            from ..durability.recovery import quarantine_file
+
+            dst = quarantine_file(config.state_file, int(time.time()))
+            log.error(
+                "ERROR: corrupt state snapshot %s (%s); quarantined to %s and "
+                "booting with empty state instead of crash-looping",
+                config.state_file, e, dst,
+            )
+    return state, None
 
 
 def resolve_config(args) -> ServerConfig:
@@ -251,15 +326,12 @@ async def amain(args) -> None:
     if config.observability.json_logs:
         log.info("structured JSON logging enabled")
 
-    state = ServerState()
-    if config.state_file and os.path.exists(config.state_file):
-        nu, ns = await state.restore(config.state_file)
-        log.info("restored state snapshot: %d users, %d sessions", nu, ns)
+    state, durability = await load_state(config)
     limiter = config.rate_limit.build_limiter()
     stop = asyncio.Event()
 
     cleanup_task = asyncio.create_task(
-        cleanup_supervisor(state, stop, config.state_file or None)
+        cleanup_supervisor(state, stop, config.state_file or None, durability)
     )
 
     if config.metrics.enabled:
@@ -303,7 +375,7 @@ async def amain(args) -> None:
             except (EOFError, KeyboardInterrupt):
                 stop.set()
                 return
-            out, quit_ = await handle_command(line, state, backend)
+            out, quit_ = await handle_command(line, state, backend, durability)
             if out:
                 print(_c("white", out))
             if quit_:
@@ -316,19 +388,34 @@ async def amain(args) -> None:
 
     await stop.wait()
 
-    # graceful shutdown: not-serving -> drain -> stop (server.rs:379-427)
+    # graceful shutdown: not-serving -> drain -> stop -> final snapshot
+    # (server.rs:379-427); background tasks are cancelled AND awaited so
+    # no in-flight sweep races the final snapshot and no "Task was
+    # destroyed but it is pending" warnings leak
     print(_c("yellow", "shutdown: flipping health to NOT_SERVING, draining..."))
     server.health.serving = False
     await asyncio.sleep(DRAIN_SECONDS)
     if batcher is not None:
         await batcher.stop()  # drain queued verifications before the listener
     await server.stop(grace=5)
-    if config.state_file:
+    cleanup_task.cancel()
+    with contextlib.suppress(asyncio.CancelledError):
+        await cleanup_task
+    if durability is not None:
+        await durability.close()  # final snapshot + truncate the covered WAL
+        log.info(
+            "durability: final snapshot written to %s, WAL truncated",
+            config.state_file,
+        )
+    elif config.state_file:
         await state.snapshot(config.state_file)
         log.info("state snapshot written to %s", config.state_file)
-    cleanup_task.cancel()
     if repl_task is not None:
         repl_task.cancel()
+        # the REPL may be blocked in a to_thread(input) that only returns
+        # on the next keypress — bound the wait instead of hanging exit
+        with contextlib.suppress(asyncio.CancelledError, asyncio.TimeoutError):
+            await asyncio.wait_for(repl_task, timeout=1.0)
     print(_c("green", "bye"))
 
 

@@ -89,6 +89,24 @@ class ObservabilitySettings:
 
 
 @dataclass
+class DurabilitySettings:
+    """Crash-consistent persistence knobs (durability subsystem): upgrade
+    the opt-in ``state_file`` snapshot to snapshot + write-ahead log, so
+    an acknowledged mutation survives a crash between cleanup sweeps.
+    See ``docs/operations.md`` §"Durability & recovery"."""
+
+    enabled: bool = False         # opt-in; requires state_file to be set
+    wal_path: str = ""            # empty = "<state_file>.wal"
+    fsync: str = "always"         # "always" | "interval" | "off"
+    fsync_interval_ms: float = 50.0  # fsync cadence under the interval
+                                     # policy (= the acknowledged-write
+                                     # loss window)
+    compact_bytes: int = 1_048_576   # compact the WAL once it outgrows
+                                     # this after a covering snapshot;
+                                     # 0 = compact on every snapshot
+
+
+@dataclass
 class RetrySettings:
     """Client retry knobs (resilience subsystem): exponential backoff with
     full jitter and a shared retry budget, applied by ``AuthClient`` to
@@ -130,6 +148,7 @@ class ServerConfig:
     observability: ObservabilitySettings = field(
         default_factory=ObservabilitySettings
     )
+    durability: DurabilitySettings = field(default_factory=DurabilitySettings)
 
     def addr(self) -> str:
         return f"{self.host}:{self.port}"
@@ -161,6 +180,7 @@ class ServerConfig:
             ("tpu", self.tpu),
             ("retry", self.retry),
             ("observability", self.observability),
+            ("durability", self.durability),
         ):
             for key, value in data.get(section, {}).items():
                 if hasattr(obj, key):
@@ -246,6 +266,17 @@ class ServerConfig:
             self.observability.trace_ring = int(v)
         if (v := get_alias("OBSERVABILITY_LATENCY_BUCKETS_MS", "OBS_LATENCY_BUCKETS_MS")) is not None:
             self.observability.latency_buckets_ms = v
+        # durability knobs (snapshot + write-ahead log)
+        if (v := get("DURABILITY_ENABLED")) is not None:
+            self.durability.enabled = v.lower() in ("1", "true", "yes", "on")
+        if (v := get("DURABILITY_WAL_PATH")) is not None:
+            self.durability.wal_path = v
+        if (v := get("DURABILITY_FSYNC")) is not None:
+            self.durability.fsync = v.lower()
+        if (v := get("DURABILITY_FSYNC_INTERVAL_MS")) is not None:
+            self.durability.fsync_interval_ms = float(v)
+        if (v := get("DURABILITY_COMPACT_BYTES")) is not None:
+            self.durability.compact_bytes = int(v)
 
     # --- validation (config.rs:238-273) ---
 
@@ -297,6 +328,19 @@ class ServerConfig:
         ):
             raise ValueError(
                 "observability.slow_request_ms must be >= 0, or -1 to disable"
+            )
+        if self.durability.fsync not in ("always", "interval", "off"):
+            raise ValueError(
+                "durability.fsync must be one of: always, interval, off"
+            )
+        if self.durability.fsync_interval_ms <= 0:
+            raise ValueError("durability.fsync_interval_ms must be positive")
+        if self.durability.compact_bytes < 0:
+            raise ValueError("durability.compact_bytes cannot be negative")
+        if self.durability.enabled and not self.state_file:
+            raise ValueError(
+                "durability.enabled requires state_file (the snapshot path "
+                "the write-ahead log is paired with)"
             )
         try:
             buckets = self.observability.parsed_buckets()

@@ -73,10 +73,11 @@ class ChallengeData:
         if not self.expires_at:
             self.expires_at = self.created_at + CHALLENGE_EXPIRY_SECONDS
 
-    def is_expired(self) -> bool:
+    def is_expired(self, now: int | None = None) -> bool:
         """TTL check with the reference's 2x-age clock-skew guard
         (state.rs:101-111)."""
-        now = _now()
+        if now is None:
+            now = _now()
         age = max(0, now - self.created_at)
         return now >= self.expires_at or age >= 2 * CHALLENGE_EXPIRY_SECONDS
 
@@ -92,8 +93,14 @@ class SessionData:
         if not self.expires_at:
             self.expires_at = self.created_at + SESSION_EXPIRY_SECONDS
 
-    def is_expired(self) -> bool:
-        return _now() >= self.expires_at
+    def is_expired(self, now: int | None = None) -> bool:
+        """Same 2x-age clock-skew guard as :meth:`ChallengeData.is_expired`
+        (state.rs:101-111): a wall clock stepping backward after mint must
+        not silently extend a bearer token's lifetime past twice its TTL."""
+        if now is None:
+            now = _now()
+        age = max(0, now - self.created_at)
+        return now >= self.expires_at or age >= 2 * SESSION_EXPIRY_SECONDS
 
 
 class ServerState:
@@ -113,6 +120,117 @@ class ServerState:
         # set on any change to persisted data (users/sessions); lets the
         # periodic snapshot skip writes on an idle server
         self._persist_dirty = True
+        # durability journal hook (WriteAheadLog | None): when attached,
+        # every acknowledged mutation to persisted data is appended —
+        # under the state lock, so WAL order always equals application
+        # order — and fsynced (per policy) before the RPC returns
+        self.journal = None
+        # WAL sequence number the last-restored snapshot covered
+        self.restored_wal_seq = 0
+        # (seq, byte offset) of the journal at the last snapshot write:
+        # the compaction watermark — everything before it is covered
+        self.snapshot_covered_seq = 0
+        self.snapshot_covered_offset = 0
+
+    # --- durability journal (cpzk_tpu/durability/) ---
+
+    def attach_journal(self, wal) -> None:
+        """Install the write-ahead log as this state's journal hook (done
+        once by ``DurabilityManager.recover`` before serving starts)."""
+        self.journal = wal
+
+    def _journal_append(self, rtype: str, payload: dict) -> None:
+        """Append one record — callers hold ``self._lock``, which pins WAL
+        order to in-memory application order."""
+        if self.journal is not None:
+            self.journal.append(rtype, payload)
+
+    async def _journal_sync(self) -> None:
+        """Make appended records durable per the WAL's fsync policy; called
+        AFTER the state lock is released (fsync flushes every earlier
+        append too, so interleaved mutations stay individually durable)
+        and BEFORE the mutation is acknowledged to the caller."""
+        wal = self.journal
+        if wal is not None and wal.needs_sync():
+            await asyncio.to_thread(wal.sync)
+
+    def replay_journal_record(self, rec: dict) -> str | None:
+        """Boot-time replay of one WAL record through the same
+        trust-boundary validators as :meth:`restore` — a tampered log
+        cannot smuggle in what the live RPC would reject.  Single-threaded
+        (recovery runs before serving starts), so no lock.  Returns None
+        when applied, else the skip reason; never raises on malformed
+        input (the fuzz harness holds this as an invariant)."""
+        from ..core.ristretto import Ristretto255
+
+        try:
+            rtype = rec.get("type")
+            if rtype == "register_user":
+                uid = str(rec["user_id"])
+                msg = user_id_error(uid)
+                if msg is not None:
+                    return msg
+                if uid in self._users:
+                    return "already registered"
+                if len(self._users) >= MAX_TOTAL_USERS:
+                    return "user capacity cap"
+                y1 = Ristretto255.element_from_bytes(bytes.fromhex(rec["y1"]))
+                y2 = Ristretto255.element_from_bytes(bytes.fromhex(rec["y2"]))
+                if Ristretto255.is_identity(y1) or Ristretto255.is_identity(y2):
+                    return "identity statement element"
+                self._users[uid] = UserData(
+                    user_id=uid,
+                    statement=Statement(y1, y2),
+                    registered_at=int(rec["registered_at"]),
+                )
+                self._persist_dirty = True
+                return None
+            if rtype == "create_session":
+                token, uid = str(rec["token"]), str(rec["user_id"])
+                created, expires = int(rec["created_at"]), int(rec["expires_at"])
+                if expires <= created or expires - created > SESSION_EXPIRY_SECONDS:
+                    return "invalid session expiry"
+                if uid not in self._users:
+                    return "unregistered user"
+                if token in self._sessions:
+                    return "duplicate session token"
+                if len(self._sessions) >= MAX_TOTAL_SESSIONS:
+                    return "session capacity cap"
+                data = SessionData(
+                    token=token, user_id=uid, created_at=created, expires_at=expires
+                )
+                if data.is_expired():
+                    return None  # same silent drop as restore()
+                per_user = self._user_sessions.setdefault(uid, [])
+                if len(per_user) >= MAX_SESSIONS_PER_USER:
+                    return "per-user session cap"
+                self._sessions[token] = data
+                per_user.append(token)
+                self._persist_dirty = True
+                return None
+            if rtype == "revoke_session":
+                data = self._sessions.pop(str(rec["token"]), None)
+                if data is None:
+                    return "session not found"
+                per_user = self._user_sessions.get(data.user_id)
+                if per_user is not None and data.token in per_user:
+                    per_user.remove(data.token)
+                self._persist_dirty = True
+                return None
+            if rtype == "expire_sessions":
+                now = int(rec["now"])
+                for t in [
+                    t for t, d in self._sessions.items() if d.is_expired(now)
+                ]:
+                    data = self._sessions.pop(t)
+                    per_user = self._user_sessions.get(data.user_id)
+                    if per_user is not None and t in per_user:
+                        per_user.remove(t)
+                self._persist_dirty = True
+                return None
+            return f"unknown record type {rtype!r}"
+        except Exception as e:  # malformed fields are a rejection, not a crash
+            return f"malformed record: {e!r}"
 
     # --- users (state.rs:136-161) ---
 
@@ -126,6 +244,20 @@ class ServerState:
                 raise InvalidParams(f"User '{user_data.user_id}' already registered")
             self._users[user_data.user_id] = user_data
             self._persist_dirty = True
+            if self.journal is not None:
+                from ..core.ristretto import Ristretto255
+
+                eb = Ristretto255.element_to_bytes
+                self._journal_append(
+                    "register_user",
+                    {
+                        "user_id": user_data.user_id,
+                        "y1": eb(user_data.statement.y1).hex(),
+                        "y2": eb(user_data.statement.y2).hex(),
+                        "registered_at": user_data.registered_at,
+                    },
+                )
+        await self._journal_sync()
 
     async def get_user(self, user_id: str) -> UserData | None:
         return (await self.get_users([user_id]))[0]
@@ -222,11 +354,22 @@ class ServerState:
                         f"User '{user_id}' has reached maximum session limit ({MAX_SESSIONS_PER_USER})"
                     )
                     continue
-                self._sessions[token] = SessionData(token=token, user_id=user_id)
+                data = SessionData(token=token, user_id=user_id)
+                self._sessions[token] = data
                 per_user.append(token)
                 self._persist_dirty = True
+                self._journal_append(
+                    "create_session",
+                    {
+                        "token": data.token,
+                        "user_id": data.user_id,
+                        "created_at": data.created_at,
+                        "expires_at": data.expires_at,
+                    },
+                )
                 out.append(None)
-            return out
+        await self._journal_sync()
+        return out
 
     async def validate_session(self, token: str) -> str:
         async with self._lock:
@@ -246,10 +389,15 @@ class ServerState:
             if per_user is not None and token in per_user:
                 per_user.remove(token)
             self._persist_dirty = True
+            self._journal_append("revoke_session", {"token": token})
+        await self._journal_sync()
 
     async def cleanup_expired_sessions(self) -> int:
         async with self._lock:
-            expired = [t for t, d in self._sessions.items() if d.is_expired()]
+            # one timestamp for the whole sweep, so the journaled record
+            # replays to exactly the set of sessions removed here
+            now = _now()
+            expired = [t for t, d in self._sessions.items() if d.is_expired(now)]
             for t in expired:
                 data = self._sessions.pop(t)
                 per_user = self._user_sessions.get(data.user_id)
@@ -257,7 +405,9 @@ class ServerState:
                     per_user.remove(t)
             if expired:
                 self._persist_dirty = True
-            return len(expired)
+                self._journal_append("expire_sessions", {"now": now})
+        await self._journal_sync()
+        return len(expired)
 
     # --- counts (state.rs:330-342) ---
 
@@ -284,6 +434,9 @@ class ServerState:
     # re-request).  Format: versioned JSON, public data only (statements
     # are public by protocol design; session tokens are bearer secrets, so
     # the file must be protected like a session store — written 0600).
+    # With a durability journal attached, each snapshot also records the
+    # WAL sequence number it covers ("wal_seq"), so boot-time recovery
+    # replays only the log suffix beyond it (cpzk_tpu/durability/).
 
     SNAPSHOT_VERSION = 1
 
@@ -329,6 +482,13 @@ class ServerState:
                         if not s.is_expired()
                     ],
                 }
+                covered: tuple[int, int] | None = None
+                if self.journal is not None:
+                    # captured under the state lock (appends hold it too),
+                    # so this (seq, byte offset) pair names EXACTLY the WAL
+                    # prefix this document covers — the compaction watermark
+                    doc["wal_seq"] = self.journal.seq
+                    covered = (self.journal.seq, self.journal.size)
                 self._persist_dirty = False
 
             def write() -> None:
@@ -366,6 +526,11 @@ class ServerState:
             except BaseException:
                 self._persist_dirty = True  # retry next sweep
                 raise
+            if covered is not None:
+                # commit the watermark only once the document is on disk:
+                # a failed write must not let compaction drop uncovered
+                # records on the strength of a snapshot that never landed
+                self.snapshot_covered_seq, self.snapshot_covered_offset = covered
             return True
 
     async def restore(self, path: str) -> tuple[int, int]:
@@ -385,6 +550,9 @@ class ServerState:
             raise InvalidParams(
                 f"Unsupported state snapshot version: {doc.get('version')!r}"
             )
+        # WAL sequence number this document covers (0 for pre-durability
+        # snapshots); recovery replays only journal records beyond it
+        wal_seq = int(doc.get("wal_seq", 0))
         # Validate and build into locals first, commit only after the FULL
         # document passes: a mid-document rejection must not leave a
         # partially-populated state (a caller catching the error and
@@ -446,4 +614,5 @@ class ServerState:
             self._sessions = sessions
             self._user_sessions = user_sessions
             self._persist_dirty = True  # freshly-restored state is unsaved
+            self.restored_wal_seq = wal_seq
             return len(users), len(sessions)

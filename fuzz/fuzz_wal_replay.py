@@ -1,0 +1,83 @@
+"""Fuzz target: WAL frame parsing + boot-time record replay.
+
+Arbitrary bytes presented as a write-ahead log must yield clean
+truncate-at-tail recovery — never an exception, never garbage state
+(the durability subsystem's trust-boundary contract).
+
+Invariants:
+- ``iter_frames`` never raises; the valid prefix is a byte offset within
+  the input, every parsed record is a dict with an int ``seq`` (strictly
+  increasing) and str ``type``;
+- parsing is **prefix-stable**: re-parsing the valid prefix alone yields
+  the same records and consumes it fully (what recovery's truncation
+  relies on — truncating at the boundary loses nothing that parsed);
+- ``ServerState.replay_journal_record`` never raises on any parsed
+  record — malformed fields come back as skip reasons, and whatever does
+  apply passes the registration-time validators (user-id rules, no
+  identity statement elements, session expiry sanity).
+
+Run: python fuzz/fuzz_wal_replay.py [--seconds 15] [--seed 0]
+"""
+
+from __future__ import annotations
+
+from common import run_fuzzer
+
+from cpzk_tpu.durability.wal import encode_record, iter_frames
+from cpzk_tpu.server.state import ServerState, user_id_error
+
+
+def _seeds() -> list[bytes]:
+    from cpzk_tpu import Parameters, Prover, SecureRng, Witness
+    from cpzk_tpu.core.ristretto import Ristretto255
+
+    rng, params = SecureRng(), Parameters.new()
+    eb = Ristretto255.element_to_bytes
+    frames = []
+    for i in range(3):
+        st = Prover(params, Witness(Ristretto255.random_scalar(rng))).statement
+        frames.append(encode_record({
+            "seq": 2 * i + 1, "type": "register_user", "user_id": f"user-{i}",
+            "y1": eb(st.y1).hex(), "y2": eb(st.y2).hex(), "registered_at": 1,
+        }))
+        frames.append(encode_record({
+            "seq": 2 * i + 2, "type": "create_session", "token": f"tok-{i}",
+            "user_id": f"user-{i}", "created_at": 10 ** 10,
+            "expires_at": 10 ** 10 + 60,
+        }))
+    frames.append(encode_record({"seq": 7, "type": "revoke_session",
+                                 "token": "tok-0"}))
+    frames.append(encode_record({"seq": 8, "type": "expire_sessions",
+                                 "now": 10 ** 10}))
+    full = b"".join(frames)
+    return [full, frames[0], full[: len(full) // 2]]
+
+
+def one_input(data: bytes) -> None:
+    records, valid = iter_frames(data)
+    assert 0 <= valid <= len(data)
+    prev = None
+    for rec in records:
+        assert isinstance(rec, dict)
+        assert isinstance(rec["seq"], int) and isinstance(rec["type"], str)
+        assert prev is None or rec["seq"] > prev
+        prev = rec["seq"]
+
+    # prefix stability: truncating at the boundary loses nothing
+    again, valid2 = iter_frames(data[:valid])
+    assert valid2 == valid and again == records
+
+    # replay must never raise; applied records passed the validators
+    state = ServerState()
+    for rec in records:
+        msg = state.replay_journal_record(rec)
+        assert msg is None or isinstance(msg, str)
+    for uid in state._users:
+        assert user_id_error(uid) is None, f"validator bypass: {uid!r}"
+    for token, sess in state._sessions.items():
+        assert sess.user_id in state._users, "session for unregistered user"
+        assert 0 < sess.expires_at - sess.created_at <= 3600
+
+
+if __name__ == "__main__":
+    run_fuzzer(one_input, _seeds())

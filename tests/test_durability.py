@@ -1,0 +1,698 @@
+"""Crash-consistent durability: WAL + bounded-loss recovery (ISSUE 3).
+
+The acceptance contract, exercised deterministically: with durability
+enabled, a crash injected at every defined crash point (and a real
+``SIGKILL`` — marked ``slow``) followed by a reboot recovers exactly the
+acknowledged prefix — every mutation acknowledged before the crash is
+present, no partially-written record is applied, and a corrupt
+snapshot/WAL quarantines and boots instead of crash-looping.
+"""
+
+import asyncio
+import dataclasses
+import json
+import os
+import pathlib
+import re
+import signal
+import subprocess
+import sys
+import textwrap
+import time
+
+import pytest
+
+from cpzk_tpu import Parameters, Prover, SecureRng, Witness
+from cpzk_tpu.core.ristretto import Ristretto255
+from cpzk_tpu.durability import (
+    DurabilityManager,
+    WriteAheadLog,
+    encode_record,
+    iter_frames,
+    read_frames,
+)
+from cpzk_tpu.resilience.faults import WAL_CRASH_POINTS, CrashPoint, FaultPlan
+from cpzk_tpu.server import metrics
+from cpzk_tpu.server.config import DurabilitySettings, ServerConfig
+from cpzk_tpu.server.state import (
+    SESSION_EXPIRY_SECONDS,
+    ServerState,
+    SessionData,
+    UserData,
+)
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+
+rng = SecureRng()
+params = Parameters.new()
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def make_statement():
+    return Prover(params, Witness(Ristretto255.random_scalar(rng))).statement
+
+
+def make_manager(tmp_path, plan=None, **settings):
+    state = ServerState()
+    cfg = DurabilitySettings(enabled=True, **settings)
+    mgr = DurabilityManager(state, cfg, str(tmp_path / "state.json"), faults=plan)
+    return state, mgr
+
+
+async def register(state, i, stmt=None):
+    await state.register_user(
+        UserData(f"u{i}", stmt if stmt is not None else make_statement(), 100 + i)
+    )
+
+
+# --- WAL unit behavior ------------------------------------------------------
+
+
+def test_wal_frame_roundtrip_and_torn_tail(tmp_path):
+    path = str(tmp_path / "log.wal")
+    wal = WriteAheadLog(path, fsync="always")
+    s1 = wal.append("register_user", {"user_id": "a"})
+    s2 = wal.append("create_session", {"token": "t"})
+    assert (s1, s2) == (1, 2)
+    wal.close()
+    assert os.stat(path).st_mode & 0o777 == 0o600
+
+    records, valid, total = read_frames(path)
+    assert valid == total == os.path.getsize(path)
+    assert [r["type"] for r in records] == ["register_user", "create_session"]
+    assert [r["seq"] for r in records] == [1, 2]
+
+    raw = open(path, "rb").read()
+    # torn tail: any strict prefix of the last frame parses to one record
+    frame1_end = len(encode_record(records[0]))
+    for cut in (frame1_end + 1, frame1_end + 7, len(raw) - 1):
+        got, v = iter_frames(raw[:cut])
+        assert [r["seq"] for r in got] == [1]
+        assert v == frame1_end
+    # bit flip inside the second payload: CRC stops the reader there
+    flipped = bytearray(raw)
+    flipped[frame1_end + 12] ^= 0x40
+    got, v = iter_frames(bytes(flipped))
+    assert [r["seq"] for r in got] == [1] and v == frame1_end
+    # non-increasing seq is corruption, not a record
+    dup = raw + encode_record({"seq": 2, "type": "register_user"})
+    got, v = iter_frames(dup)
+    assert [r["seq"] for r in got] == [1, 2] and v == len(raw)
+
+
+def test_wal_fsync_policies(tmp_path):
+    always = WriteAheadLog(str(tmp_path / "a.wal"), fsync="always")
+    base = metrics.read("state.wal.fsyncs")
+    always.append("register_user", {})
+    assert always.needs_sync() and always.sync() is True
+    assert metrics.read("state.wal.fsyncs") == base + 1
+    assert always.needs_sync() is False  # nothing pending
+    always.close()
+
+    off = WriteAheadLog(str(tmp_path / "b.wal"), fsync="off")
+    off.append("register_user", {})
+    assert off.needs_sync() is False and off.sync() is False
+    assert off.sync(force=True) is True  # shutdown still flushes
+    off.close()
+
+    iv = WriteAheadLog(
+        str(tmp_path / "c.wal"), fsync="interval", fsync_interval_ms=10_000.0
+    )
+    iv.append("register_user", {})
+    assert iv.needs_sync() is False  # interval not elapsed
+    assert iv.sync() is False and iv.pending == 1
+    iv._last_fsync -= 11.0  # age the clock past the interval
+    assert iv.needs_sync() is True and iv.sync() is True
+    iv.close()
+
+    with pytest.raises(ValueError, match="fsync policy"):
+        WriteAheadLog(str(tmp_path / "d.wal"), fsync="sometimes")
+
+
+def test_journal_logs_every_acknowledged_mutation(tmp_path):
+    async def main():
+        state, mgr = make_manager(tmp_path)
+        await mgr.recover()
+        await register(state, 0)
+        await state.create_session("tok", "u0")
+        await state.revoke_session("tok")
+        await state.create_session("tok2", "u0")
+        # inject an expired session so the sweep journals its record
+        state._sessions["dead"] = SessionData(
+            token="dead", user_id="u0", created_at=1, expires_at=2
+        )
+        state._user_sessions.setdefault("u0", []).append("dead")
+        assert await state.cleanup_expired_sessions() == 1
+        mgr.wal.close()
+        return read_frames(mgr.wal_path)[0]
+
+    records = run(main())
+    assert [r["type"] for r in records] == [
+        "register_user", "create_session", "revoke_session",
+        "create_session", "expire_sessions",
+    ]
+    assert [r["seq"] for r in records] == [1, 2, 3, 4, 5]
+    sess = records[1]
+    assert sess["token"] == "tok" and sess["user_id"] == "u0"
+    assert sess["expires_at"] - sess["created_at"] == SESSION_EXPIRY_SECONDS
+
+
+# --- recovery ---------------------------------------------------------------
+
+
+def test_recovery_replays_only_suffix_beyond_snapshot(tmp_path):
+    async def main():
+        state, mgr = make_manager(tmp_path)
+        await mgr.recover()
+        stmts = {i: make_statement() for i in range(4)}
+        for i in range(2):
+            await register(state, i, stmts[i])
+        assert await mgr.checkpoint() is True  # snapshot covers seq 1-2
+        for i in range(2, 4):
+            await register(state, i, stmts[i])
+        await state.create_session("tok", "u3")
+        # crash without a further snapshot; reboot into a fresh state
+        base = metrics.read("state.recovery.replayed")
+        state2, mgr2 = make_manager(tmp_path)
+        report = await mgr2.recover()
+        assert report.snapshot_loaded and (report.users, report.sessions) == (2, 0)
+        assert report.covered_seq == 2 and report.replayed == 3
+        assert report.skipped == 0 and report.truncated_bytes == 0
+        assert metrics.read("state.recovery.replayed") == base + 3
+        assert await state2.user_count() == 4
+        for i in range(4):
+            u = await state2.get_user(f"u{i}")
+            assert u is not None and u.statement == stmts[i]
+        assert await state2.validate_session("tok") == "u3"
+        # the snapshot doc itself records the covered sequence number
+        assert json.load(open(mgr2.state_file))["wal_seq"] == 2
+
+    run(main())
+
+
+@pytest.mark.parametrize("point", ["pre_append", "mid_frame", "post_append_pre_fsync"])
+def test_crash_point_recovers_exactly_the_acknowledged_prefix(tmp_path, point):
+    """The tentpole acceptance: a crash at every append-side crash point
+    reboots to all acknowledged writes and never a torn record."""
+    acked = 3  # registrations acknowledged before the crash
+
+    async def main():
+        plan = FaultPlan().crash_on(point, occurrence=acked)
+        state, mgr = make_manager(tmp_path, plan=plan)
+        await mgr.recover()
+        for i in range(acked):
+            await register(state, i)
+        with pytest.raises(CrashPoint):
+            await register(state, acked)
+
+        state2, mgr2 = make_manager(tmp_path)
+        report = await mgr2.recover()
+        for i in range(acked):
+            assert await state2.get_user(f"u{i}") is not None
+        if point == "mid_frame":
+            # the torn frame was truncated away, byte-exactly
+            assert report.truncated_bytes > 0
+            assert await state2.get_user(f"u{acked}") is None
+            assert os.path.getsize(mgr2.wal_path) == read_frames(mgr2.wal_path)[1]
+        elif point == "pre_append":
+            assert report.truncated_bytes == 0
+            assert await state2.get_user(f"u{acked}") is None
+        else:  # post_append_pre_fsync: full frame on disk, never fsynced.
+            # In-process the page cache survives, so the unacknowledged
+            # write MAY appear — allowed (only loss of acked writes and
+            # application of torn records are contract violations).
+            assert report.truncated_bytes == 0
+        # the reopened log accepts appends and a clean reboot sees them
+        await register(state2, 90)
+        state3, mgr3 = make_manager(tmp_path)
+        await mgr3.recover()
+        assert await state3.get_user("u90") is not None
+
+    run(main())
+
+
+def test_crash_pre_rename_leaves_compaction_all_or_nothing(tmp_path):
+    async def main():
+        plan = FaultPlan().crash_on("pre_rename", occurrence=0)
+        state, mgr = make_manager(tmp_path, plan=plan, compact_bytes=0)
+        await mgr.recover()
+        for i in range(3):
+            await register(state, i)
+        size_before = mgr.wal.size
+        with pytest.raises(CrashPoint):
+            await mgr.checkpoint()  # snapshot lands, compaction rename dies
+        assert os.path.getsize(mgr.wal_path) == size_before  # old log intact
+        # reboot: snapshot + (uncompacted) WAL still recover everything
+        state2, mgr2 = make_manager(tmp_path, compact_bytes=0)
+        await mgr2.recover()
+        assert await state2.user_count() == 3
+        # and an unfaulted checkpoint compacts for real
+        await register(state2, 3)
+        await mgr2.checkpoint()
+        assert os.path.getsize(mgr2.wal_path) == 0
+        state3, mgr3 = make_manager(tmp_path)
+        report = await mgr3.recover()
+        assert await state3.user_count() == 4 and report.replayed == 0
+
+    run(main())
+
+
+def test_compaction_triggers_past_size_threshold(tmp_path):
+    async def main():
+        state, mgr = make_manager(tmp_path, compact_bytes=10_000)
+        await mgr.recover()
+        for i in range(4):
+            await register(state, i)
+        await mgr.checkpoint()
+        # covered, but under the threshold: nothing compacted
+        assert mgr.wal.size > 0
+        for i in range(4, 60):
+            await register(state, i)
+        assert mgr.wal.size > 10_000
+        await mgr.checkpoint()  # now past the threshold -> compact
+        # everything the snapshot covers is gone; nothing was appended
+        # after the snapshot, so the log is empty
+        assert mgr.wal.size == 0
+        state2, mgr2 = make_manager(tmp_path)
+        report = await mgr2.recover()
+        assert await state2.user_count() == 60 and report.replayed == 0
+
+    run(main())
+
+
+def test_shutdown_close_truncates_covered_wal(tmp_path):
+    async def main():
+        state, mgr = make_manager(tmp_path)
+        await mgr.recover()
+        for i in range(5):
+            await register(state, i)
+        assert mgr.wal.size > 0
+        await mgr.close()  # drain -> final snapshot -> truncate
+        assert os.path.getsize(mgr.wal_path) == 0
+        with pytest.raises(OSError, match="closed"):
+            mgr.wal.append("register_user", {})
+        state2, mgr2 = make_manager(tmp_path)
+        report = await mgr2.recover()
+        assert await state2.user_count() == 5
+        assert report.replayed == 0  # snapshot covers everything
+
+    run(main())
+
+
+# --- quarantine paths -------------------------------------------------------
+
+
+def test_unreadable_wal_quarantined_boots_from_snapshot(tmp_path):
+    async def main():
+        state, mgr = make_manager(tmp_path)
+        await mgr.recover()
+        for i in range(3):
+            await register(state, i)
+        await mgr.checkpoint()
+        mgr.wal.close()
+        # clobber the log from byte 0: not a torn tail, garbage outright
+        with open(mgr.wal_path, "wb") as f:
+            f.write(b"\xff" * 64)
+        state2, mgr2 = make_manager(tmp_path)
+        report = await mgr2.recover()
+        assert report.wal_quarantined is not None
+        assert os.path.exists(report.wal_quarantined)
+        assert ".corrupt-" in report.wal_quarantined
+        assert os.stat(report.wal_quarantined).st_mode & 0o777 == 0o600
+        assert await state2.user_count() == 3  # snapshot carried the day
+        await register(state2, 3)  # fresh log accepts writes
+        assert read_frames(mgr2.wal_path)[0][0]["type"] == "register_user"
+
+    run(main())
+
+
+def test_corrupt_snapshot_quarantined_boots_from_wal(tmp_path):
+    async def main():
+        state, mgr = make_manager(tmp_path)
+        await mgr.recover()
+        for i in range(3):
+            await register(state, i)
+        await state.create_session("tok", "u1")
+        await mgr.checkpoint()
+        mgr.wal.close()
+        # tamper the snapshot; the full (uncompacted) WAL remains good
+        doc = open(mgr.state_file).read()
+        with open(mgr.state_file, "w") as f:
+            f.write(doc[: len(doc) // 2])
+        state2, mgr2 = make_manager(tmp_path)
+        report = await mgr2.recover()
+        assert report.snapshot_quarantined is not None
+        assert not report.snapshot_loaded
+        assert re.search(r"\.corrupt-\d+", report.snapshot_quarantined)
+        assert os.stat(report.snapshot_quarantined).st_mode & 0o777 == 0o600
+        # the WAL alone rebuilt the whole acknowledged state
+        assert report.replayed == 4
+        assert await state2.user_count() == 3
+        assert await state2.validate_session("tok") == "u1"
+
+    run(main())
+
+
+def test_corrupt_snapshot_without_durability_quarantines_not_crashloops(
+    tmp_path, monkeypatch
+):
+    """Satellite: the plain --state-file boot path must quarantine a
+    snapshot that fails restore() instead of dying on every restart."""
+    from cpzk_tpu.server.__main__ import load_state
+
+    monkeypatch.chdir(tmp_path)  # no stray config/server.toml pickup
+    path = tmp_path / "state.json"
+    path.write_text('{"version": 1, "users": {"bad user!": ')
+    os.chmod(path, 0o600)
+    cfg = ServerConfig()
+    cfg.state_file = str(path)
+
+    async def main():
+        state, durability = await load_state(cfg)
+        assert durability is None
+        assert await state.user_count() == 0  # booted empty, not crashed
+        assert not path.exists()  # moved aside
+        corrupt = [p for p in tmp_path.iterdir() if ".corrupt-" in p.name]
+        assert len(corrupt) == 1
+        assert os.stat(corrupt[0]).st_mode & 0o777 == 0o600
+
+    run(main())
+
+
+def test_load_state_with_durability_end_to_end(tmp_path, monkeypatch):
+    """amain's boot path: recover, write a fresh covering snapshot."""
+    from cpzk_tpu.server.__main__ import load_state
+
+    monkeypatch.chdir(tmp_path)
+    cfg = ServerConfig()
+    cfg.state_file = str(tmp_path / "state.json")
+    cfg.durability.enabled = True
+    cfg.validate()
+
+    async def main():
+        state, durability = await load_state(cfg)
+        assert durability is not None and durability.wal is not None
+        await register(state, 0)
+        # crash (no shutdown); second boot replays the WAL...
+        state2, durability2 = await load_state(cfg)
+        assert await state2.user_count() == 1
+        # ...and load_state's post-recovery checkpoint made the snapshot
+        # cover it, so a third boot replays nothing
+        assert json.load(open(cfg.state_file))["wal_seq"] == durability2.wal.seq
+
+    run(main())
+
+
+# --- replay validation ------------------------------------------------------
+
+
+def test_replay_rejects_what_the_rpc_would(tmp_path):
+    st = ServerState()
+    good = make_statement()
+    eb = Ristretto255.element_to_bytes
+    y1, y2 = eb(good.y1).hex(), eb(good.y2).hex()
+
+    ok = st.replay_journal_record({
+        "seq": 1, "type": "register_user", "user_id": "alice",
+        "y1": y1, "y2": y2, "registered_at": 5,
+    })
+    assert ok is None and "alice" in st._users
+    # the same trust boundary as restore(): bad ids, identity elements,
+    # duplicates, unregistered session users, insane expiries, junk
+    cases = [
+        ({"seq": 2, "type": "register_user", "user_id": "bad user!",
+          "y1": y1, "y2": y2, "registered_at": 1}, "invalid characters"),
+        ({"seq": 3, "type": "register_user", "user_id": "eve",
+          "y1": "00" * 32, "y2": y2, "registered_at": 1}, "identity"),
+        ({"seq": 4, "type": "register_user", "user_id": "alice",
+          "y1": y1, "y2": y2, "registered_at": 1}, "already registered"),
+        ({"seq": 5, "type": "create_session", "token": "t",
+          "user_id": "nobody", "created_at": 10, "expires_at": 20},
+         "unregistered"),
+        ({"seq": 6, "type": "create_session", "token": "t",
+          "user_id": "alice", "created_at": 10, "expires_at": 10 ** 9},
+         "expiry"),
+        ({"seq": 7, "type": "revoke_session", "token": "ghost"}, "not found"),
+        ({"seq": 8, "type": "mint_money", "amount": 1}, "unknown record"),
+        ({"seq": 9, "type": "register_user"}, "malformed"),
+        ({"seq": 10, "type": "register_user", "user_id": "mallory",
+          "y1": "zz", "y2": y2, "registered_at": 1}, "malformed"),
+    ]
+    for rec, needle in cases:
+        msg = st.replay_journal_record(rec)
+        assert msg is not None and needle in msg, (rec, msg)
+    assert list(st._users) == ["alice"] and not st._sessions
+
+
+def test_replayed_expiry_sweep_matches_original(tmp_path):
+    async def main():
+        state, mgr = make_manager(tmp_path)
+        await mgr.recover()
+        await register(state, 0)
+        await state.create_session("live", "u0")
+        state._sessions["dead"] = SessionData(
+            token="dead", user_id="u0", created_at=1, expires_at=2
+        )
+        state._user_sessions["u0"].append("dead")
+        # the dead session is journaled (direct map injection bypasses the
+        # journal) only via the sweep record; replay must drop exactly it
+        await state.cleanup_expired_sessions()
+        state2, mgr2 = make_manager(tmp_path)
+        await mgr2.recover()
+        assert await state2.validate_session("live") == "u0"
+        assert "dead" not in state2._sessions
+
+    run(main())
+
+
+# --- satellite: session clock-skew guard ------------------------------------
+
+
+def test_session_expiry_has_clock_skew_guard():
+    now = int(time.time())
+    # clock stepped backward after mint: expires_at is still in the
+    # (new) future, but the session is over twice its TTL old
+    skewed = SessionData(
+        token="t", user_id="u",
+        created_at=now - 2 * SESSION_EXPIRY_SECONDS,
+        expires_at=now + 1000,
+    )
+    assert skewed.is_expired()
+    fresh = SessionData(token="t", user_id="u")
+    assert not fresh.is_expired()
+    # the guard takes an explicit clock like ChallengeData's
+    assert fresh.is_expired(now + SESSION_EXPIRY_SECONDS + 1)
+    assert not fresh.is_expired(now + 10)
+
+
+# --- config + drift guard ---------------------------------------------------
+
+
+def test_durability_config_layering_and_validation(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)  # no stray .env/config pickup
+    cfg = ServerConfig.from_env()
+    assert cfg.durability.enabled is False
+    assert cfg.durability.fsync == "always"
+
+    (tmp_path / "server.toml").write_text(
+        '[durability]\nenabled = true\nfsync = "interval"\n'
+        "compact_bytes = 4096\n"
+    )
+    monkeypatch.setenv("SERVER_CONFIG_PATH", str(tmp_path / "server.toml"))
+    monkeypatch.setenv("SERVER_STATE_FILE", str(tmp_path / "s.json"))
+    cfg = ServerConfig.from_env()
+    assert cfg.durability.enabled is True
+    assert cfg.durability.fsync == "interval"
+    assert cfg.durability.compact_bytes == 4096
+    cfg.validate()
+    # env overrides TOML
+    monkeypatch.setenv("SERVER_DURABILITY_FSYNC", "OFF")
+    monkeypatch.setenv("SERVER_DURABILITY_FSYNC_INTERVAL_MS", "125")
+    monkeypatch.setenv("SERVER_DURABILITY_WAL_PATH", "/tmp/x.wal")
+    cfg = ServerConfig.from_env()
+    assert cfg.durability.fsync == "off"
+    assert cfg.durability.fsync_interval_ms == 125.0
+    assert cfg.durability.wal_path == "/tmp/x.wal"
+
+    bad = ServerConfig()
+    bad.durability.enabled = True  # without a state_file
+    with pytest.raises(ValueError, match="requires state_file"):
+        bad.validate()
+    bad = ServerConfig()
+    bad.durability.fsync = "sometimes"
+    with pytest.raises(ValueError, match="durability.fsync"):
+        bad.validate()
+    bad = ServerConfig()
+    bad.durability.compact_bytes = -1
+    with pytest.raises(ValueError, match="compact_bytes"):
+        bad.validate()
+
+
+def test_durability_config_keys_documented():
+    """CI drift guard: every [durability] knob ships in the TOML example,
+    the .env example, and the operations-doc knob inventory."""
+    keys = [f.name for f in dataclasses.fields(DurabilitySettings)]
+    assert keys  # the guard itself must not silently go vacuous
+
+    toml_text = (ROOT / "config" / "server.toml.example").read_text()
+    m = re.search(r"^\[durability\]$", toml_text, re.M)
+    assert m, "[durability] section missing from config/server.toml.example"
+    section = toml_text[m.end():].split("\n[", 1)[0]
+    env_text = (ROOT / ".env.example").read_text()
+    docs = (ROOT / "docs" / "operations.md").read_text()
+    for key in keys:
+        assert re.search(rf"^{key}\s*=", section, re.M), (
+            f"[durability] key {key!r} missing from config/server.toml.example"
+        )
+        assert f"SERVER_DURABILITY_{key.upper()}" in env_text, (
+            f"SERVER_DURABILITY_{key.upper()} missing from .env.example"
+        )
+        assert f"`durability.{key}`" in docs, (
+            f"`durability.{key}` missing from the docs/operations.md "
+            "knob inventory"
+        )
+
+
+def test_persist_repl_command(tmp_path):
+    from cpzk_tpu.server.__main__ import handle_command
+
+    async def main():
+        state, mgr = make_manager(tmp_path)
+        await mgr.recover()
+        out, quit_ = await handle_command("/persist", state, None, None)
+        assert "durability disabled" in out and not quit_
+        await register(state, 0)
+        await mgr.checkpoint()
+        out, quit_ = await handle_command("/persist", state, None, mgr)
+        assert not quit_
+        assert f"seq={mgr.wal.seq}" in out
+        assert f"covered_seq={mgr.covered_seq}" in out
+        assert "fsync=always" in out and "last_fsync_age=" in out
+        assert "snapshot_age=" in out and "n/a" not in out
+        assert metrics.read("state.snapshot.age_seconds", "g") >= 0.0
+
+    run(main())
+
+
+def test_grpc_crash_recovery_without_any_snapshot(tmp_path):
+    """End-to-end over the wire: register + login on a live gRPC server,
+    hard-crash (no snapshot, no graceful close), reboot from the WAL
+    alone, and log in WITHOUT re-registering — the acknowledged-RPC
+    durability story the snapshot-only design could not tell."""
+    from cpzk_tpu.client import AuthClient
+    from cpzk_tpu.client.__main__ import do_login, do_register
+    from cpzk_tpu.server import RateLimiter
+    from cpzk_tpu.server.service import serve
+
+    async def main():
+        state, mgr = make_manager(tmp_path)
+        await mgr.recover()
+        server, port = await serve(state, RateLimiter(1000, 1000), port=0)
+        async with AuthClient(f"127.0.0.1:{port}") as c:
+            assert "Registered" in await do_register(c, "carol", "pw-carol")
+            assert "Login OK" in await do_login(c, "carol", "pw-carol")
+        await server.stop(None)
+        assert not os.path.exists(mgr.state_file)  # truly no snapshot
+
+        state2, mgr2 = make_manager(tmp_path)
+        report = await mgr2.recover()
+        assert report.replayed >= 2  # the registration + the session mint
+        assert await state2.session_count() == 1  # the login session too
+        server2, port2 = await serve(state2, RateLimiter(1000, 1000), port=0)
+        async with AuthClient(f"127.0.0.1:{port2}") as c:
+            assert "Login OK" in await do_login(c, "carol", "pw-carol")
+            assert "Login OK" not in await do_login(c, "carol", "wrong")
+        await server2.stop(None)
+
+    run(main())
+
+
+# --- the real thing: SIGKILL a subprocess mid-traffic -----------------------
+
+
+_KILL_CHILD = textwrap.dedent("""
+    import asyncio, sys
+    sys.path.insert(0, {root!r})
+
+    from cpzk_tpu import Parameters, Prover, SecureRng, Witness
+    from cpzk_tpu.core.ristretto import Ristretto255
+    from cpzk_tpu.durability import DurabilityManager
+    from cpzk_tpu.server.config import DurabilitySettings
+    from cpzk_tpu.server.state import ServerState, UserData
+
+    async def main():
+        state = ServerState()
+        mgr = DurabilityManager(
+            state, DurabilitySettings(enabled=True, fsync="always"),
+            {state_file!r},
+        )
+        await mgr.recover()
+        rng, params = SecureRng(), Parameters.new()
+        i = 0
+        while True:
+            stmt = Prover(params, Witness(Ristretto255.random_scalar(rng))).statement
+            await state.register_user(UserData(f"user-{{i:04d}}", stmt, 1))
+            # the register returned: the write is acknowledged (fsynced)
+            print(f"ACK user-{{i:04d}}", flush=True)
+            i += 1
+
+    asyncio.run(main())
+""")
+
+
+@pytest.mark.slow
+def test_sigkill_mid_traffic_recovers_every_acknowledged_write(tmp_path):
+    """Register users in a real subprocess with fsync=always, SIGKILL it
+    mid-traffic, reboot in-parent: every acknowledged write survived and
+    no torn record applied."""
+    state_file = str(tmp_path / "state.json")
+    script = _KILL_CHILD.format(root=str(ROOT), state_file=state_file)
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.Popen(
+        [sys.executable, "-u", "-c", script],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE,
+        text=True,
+        env=env,
+        cwd=str(ROOT),
+    )
+    acked = []
+    try:
+        deadline = time.monotonic() + 120
+        while len(acked) < 8 and time.monotonic() < deadline:
+            line = proc.stdout.readline()
+            if not line:
+                break
+            if line.startswith("ACK "):
+                acked.append(line.split()[1])
+        # kill without any grace, mid-traffic (likely mid-append)
+        proc.send_signal(signal.SIGKILL)
+        proc.wait(timeout=30)
+    finally:
+        if proc.poll() is None:  # pragma: no cover - cleanup on failure
+            proc.kill()
+            proc.wait()
+    assert len(acked) >= 8, (acked, proc.stderr.read())
+
+    async def reboot():
+        state = ServerState()
+        mgr = DurabilityManager(
+            state, DurabilitySettings(enabled=True), state_file
+        )
+        report = await mgr.recover()
+        for uid in acked:
+            assert await state.get_user(uid) is not None, (
+                f"acknowledged write {uid} lost after SIGKILL ({report})"
+            )
+        # no torn record applied: the reopened log is byte-exact frames
+        records, valid, total = read_frames(mgr.wal_path)
+        assert valid == total
+        # all surviving users are well-formed (no garbage applied)
+        for uid in state._users:
+            assert re.fullmatch(r"user-\d{4}", uid)
+
+    run(reboot())
