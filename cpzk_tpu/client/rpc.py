@@ -22,13 +22,37 @@ import random
 import grpc
 
 from ..observability.context import RequestContext
-from ..resilience.retry import RetryPolicy
+from ..resilience.retry import RETRY_PUSHBACK_KEY, RetryPolicy
 from ..server.proto import SERVICE_NAME, load_pb2, method_types
 
 #: RPCs safe to resend on a transient failure.  Register re-sent after an
 #: unreported success fails loudly with ALREADY_EXISTS (never silently
 #: corrupts); CreateChallenge just mints a fresh nonce; health is pure.
 _RETRY_SAFE = frozenset({"Register", "RegisterBatch", "CreateChallenge", "HealthCheck"})
+
+#: Metadata tag carrying the caller's self-chosen identity for per-client
+#: fair admission (see cpzk_tpu.admission.limiter.client_key).
+CLIENT_ID_KEY = "cpzk-client-id"
+
+
+def _pushback_ms(err) -> float | None:
+    """Server retry pushback from an RpcError's trailing metadata
+    (``cpzk-retry-after-ms``), or None when absent/unparseable.  Negative
+    values are returned as-is — they mean "do not retry" (gRFC A6)."""
+    try:
+        trailing = err.trailing_metadata()
+    except Exception:
+        return None
+    for key, value in trailing or ():
+        if str(key).lower() != RETRY_PUSHBACK_KEY:
+            continue
+        if isinstance(value, bytes):
+            value = value.decode("ascii", "replace")
+        try:
+            return float(value)
+        except (TypeError, ValueError):
+            return None
+    return None
 
 
 class AuthClient:
@@ -40,9 +64,14 @@ class AuthClient:
         credentials: grpc.ChannelCredentials | None = None,
         retry: RetryPolicy | None = None,
         retry_rng: random.Random | None = None,
+        client_id: str | None = None,
     ):
         self.pb2 = load_pb2()
         self.retry = retry
+        #: sent as ``cpzk-client-id`` metadata on every RPC so the server
+        #: keys fair admission to this identity rather than the peer
+        #: address (useful behind proxies / NAT).
+        self.client_id = client_id
         #: trace context of the most recent RPC attempt (observability).
         self.last_context: RequestContext | None = None
         # injectable RNG so chaos tests get deterministic jitter
@@ -84,32 +113,51 @@ class AuthClient:
         trace ring shows a retried request as one trace with several
         completions.  The most recent context is kept on
         ``self.last_context`` for callers that want to correlate their
-        own logs with the server's."""
+        own logs with the server's.
+
+        Server pushback (gRFC A6): a rejection carrying
+        ``cpzk-retry-after-ms`` trailing metadata overrides the jittered
+        backoff — the sleep is exactly the server-advertised delay
+        (sized from its queue drain rate).  Negative pushback means the
+        server asked us not to retry at all.  The retry budget and
+        attempt cap still apply either way."""
         rctx = RequestContext()
         self.last_context = rctx
         policy = self.retry
         if policy is None or name not in _RETRY_SAFE:
             return await stub(
-                request, timeout=timeout, metadata=rctx.to_metadata()
+                request, timeout=timeout, metadata=self._metadata(rctx)
             )
         while True:
             try:
                 response = await stub(
-                    request, timeout=timeout, metadata=rctx.to_metadata()
+                    request, timeout=timeout, metadata=self._metadata(rctx)
                 )
             except grpc.RpcError as e:
                 code = e.code()
                 code_name = code.name if code is not None else ""
+                pushback = _pushback_ms(e)
+                if pushback is not None and pushback < 0:
+                    raise  # server pushback: do not retry
                 if not policy.should_retry(code_name, rctx.attempt):
                     raise
                 await asyncio.sleep(
-                    policy.backoff_s(rctx.attempt, self._retry_rng)
+                    policy.sleep_s(
+                        rctx.attempt, pushback_ms=pushback,
+                        rng=self._retry_rng,
+                    )
                 )
                 rctx = rctx.child()  # same trace id, attempt + 1
                 self.last_context = rctx
                 continue
             policy.note_success()
             return response
+
+    def _metadata(self, rctx: RequestContext):
+        md = rctx.to_metadata()
+        if self.client_id:
+            md += ((CLIENT_ID_KEY, self.client_id),)
+        return md
 
     # --- RPCs ---
 
@@ -170,7 +218,13 @@ class AuthClient:
             timeout,
         )
 
-    async def health_check(self, timeout: float | None = None):
+    async def health_check(
+        self, timeout: float | None = None, service: str = ""
+    ):
+        # service="" is the liveness probe; service="readiness" (or the
+        # auth service name) additionally reports NOT_SERVING while the
+        # backend is degraded or WAL recovery is still replaying, so load
+        # balancers stop routing to a replica that would only shed.
         from ..server.proto import load_health_pb2
 
         pb2 = load_health_pb2()
@@ -180,5 +234,6 @@ class AuthClient:
             response_deserializer=pb2.HealthCheckResponse.FromString,
         )
         return await self._call(
-            "HealthCheck", stub, pb2.HealthCheckRequest(service=""), timeout
+            "HealthCheck", stub, pb2.HealthCheckRequest(service=service),
+            timeout,
         )

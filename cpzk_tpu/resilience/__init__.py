@@ -22,11 +22,12 @@ dependency-free modules and resolves the rest on attribute access.
 from __future__ import annotations
 
 from .breaker import BreakerState, CircuitBreaker
-from .retry import RetryBudget, RetryPolicy
+from .retry import RETRY_PUSHBACK_KEY, RetryBudget, RetryPolicy
 
 __all__ = [
     "BreakerState",
     "CircuitBreaker",
+    "RETRY_PUSHBACK_KEY",
     "RetryBudget",
     "RetryPolicy",
     "CrashPoint",

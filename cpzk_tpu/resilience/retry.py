@@ -27,6 +27,18 @@ from dataclasses import dataclass, field
 #: deliberately absent — the work may have committed server-side.
 DEFAULT_RETRYABLE_CODES = ("UNAVAILABLE", "RESOURCE_EXHAUSTED")
 
+#: Trailing-metadata key for server retry pushback (gRFC A6): the server
+#: attaches it to every admission/overload rejection, sized from the
+#: current queue drain rate; the client sleeps exactly this long instead
+#: of its own jittered backoff.  A negative value means "do not retry".
+#: Held here (not in the admission package) so the client side needs
+#: neither grpcio nor the server modules to know the key.
+RETRY_PUSHBACK_KEY = "cpzk-retry-after-ms"
+
+#: Safety ceiling on honoring server pushback: a buggy or hostile server
+#: must not be able to park a client for minutes with one header.
+MAX_PUSHBACK_S = 30.0
+
 
 class RetryBudget:
     """Channel-wide retry token bucket (gRFC A6 ``retryThrottling``).
@@ -97,6 +109,22 @@ class RetryPolicy:
             self.initial_backoff_s * self.multiplier ** max(0, attempt - 1),
         )
         return (rng or random).uniform(0.0, cap)
+
+    def sleep_s(
+        self,
+        attempt: int,
+        pushback_ms: float | None = None,
+        rng: random.Random | None = None,
+    ) -> float:
+        """The sleep before retry ``attempt``: server pushback verbatim
+        when present (gRFC A6 — the server knows its queue drain rate,
+        the client's jitter schedule does not), capped at
+        :data:`MAX_PUSHBACK_S`; otherwise the full-jitter backoff.
+        Negative pushback ("do not retry") is the *caller's* decision to
+        enforce before sleeping — here it falls back to jitter."""
+        if pushback_ms is not None and pushback_ms >= 0:
+            return min(MAX_PUSHBACK_S, pushback_ms / 1000.0)
+        return self.backoff_s(attempt, rng)
 
     def should_retry(self, code_name: str, attempt: int) -> bool:
         """Policy decision for a failed attempt (1-based): code retryable,

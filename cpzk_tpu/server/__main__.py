@@ -151,6 +151,7 @@ async def cleanup_supervisor(
 
 HELP = """Available commands:
   /status      (/st)  server status summary (incl. backend breaker state)
+  /overload    (/ov)  admission status: level, tiers, clients, pushback
   /tracez [N]  (/tz)  last N completed request traces w/ stage breakdown
   /persist     (/wal) durability status: WAL size, fsync age, covered seq
   /users       (/u)   registered user count
@@ -163,13 +164,15 @@ HELP = """Available commands:
 
 
 async def handle_command(
-    cmd: str, state: ServerState, backend=None, durability=None
+    cmd: str, state: ServerState, backend=None, durability=None,
+    admission=None,
 ) -> tuple[str, bool]:
     """(output, should_quit) for one REPL line (server.rs:50-90,261-359).
     ``backend`` is the serving FailoverBackend (None on the inline CPU
     path) — /status surfaces its breaker state, /reset re-arms it;
     ``durability`` is the DurabilityManager behind /persist (None when
-    durability is disabled)."""
+    durability is disabled); ``admission`` is the AdmissionController
+    behind /overload (None when admission is disabled)."""
     cmd = cmd.strip()
     if not cmd:
         return "", False
@@ -190,6 +193,30 @@ async def handle_command(
                 f" expired_shed={int(metrics.read('tpu.queue.expired'))}"
             )
         return line, False
+    if word in ("/overload", "/ov"):
+        if admission is None:
+            return (
+                "admission control disabled (set [admission] enabled = true "
+                "to get per-client fairness + priority shedding)",
+                False,
+            )
+        s = admission.snapshot()
+        tiers = "+".join(s["admitted_tiers"]) or "none"
+        return (
+            f"level={s['level']:.2f}/3 admitting={tiers}"
+            f" clients={s['clients']}/{s['max_clients']}"
+            f" (evicted={s['evictions']})"
+            f" queue={s['queue_depth']}/{s['queue_capacity']}"
+            f" drain={s['drain_rate']:.1f}/s"
+            f" util={s['utilization']:.2f}"
+            f" queue_wait={s['queue_wait_ms']:.1f}ms"
+            f" retry_after={s['retry_after_ms']:.0f}ms"
+            f" admitted={int(s['admitted'])}"
+            f" shed{{client={int(s['shed_per_client'])}"
+            f" priority={int(s['shed_priority'])}"
+            f" global={int(s['shed_global'])}}}",
+            False,
+        )
     if word in ("/tracez", "/traces", "/tz"):
         from ..observability import format_tracez, get_tracer
 
@@ -357,9 +384,19 @@ async def amain(args) -> None:
             config.tpu.batch_max, config.tpu.batch_window_ms,
         )
 
+    admission = None
+    if config.admission.enabled:
+        from ..admission import AdmissionController
+
+        admission = AdmissionController(config.admission, batcher=batcher)
+        log.info(
+            "admission control enabled (per_client_rpm=%d, max_clients=%d)",
+            config.admission.per_client_rpm, config.admission.max_clients,
+        )
+
     server, port = await serve(
         state, limiter, host=config.host, port=config.port,
-        backend=backend, batcher=batcher, tls=tls,
+        backend=backend, batcher=batcher, tls=tls, admission=admission,
     )
     print(_c("green", f"AuthService listening on {config.host}:{port}"))
 
@@ -375,7 +412,9 @@ async def amain(args) -> None:
             except (EOFError, KeyboardInterrupt):
                 stop.set()
                 return
-            out, quit_ = await handle_command(line, state, backend, durability)
+            out, quit_ = await handle_command(
+                line, state, backend, durability, admission
+            )
             if out:
                 print(_c("white", out))
             if quit_:

@@ -102,6 +102,10 @@ class DynamicBatcher:
         self._task: asyncio.Task | None = None
         self._stopping = False
         self._rng = SecureRng()
+        # drain-rate EWMA (entries resolved per second): the admission
+        # controller sizes cpzk-retry-after-ms pushback from it
+        self._drained_at: float | None = None
+        self._drain_rate = 0.0
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -267,6 +271,31 @@ class DynamicBatcher:
             # still resolving (satellite fix)
             self._inflight_entries -= len(take)
             self._set_depth_gauge()
+            self._note_drain(len(take))
+
+    # -- load signals (admission subsystem seam) ---------------------------
+
+    def load_snapshot(self) -> tuple[int, int]:
+        """(entries queued + claimed in flight, queue capacity) — the
+        utilization signal the admission controller adapts on."""
+        return len(self._queue) + self._inflight_entries, self.max_queue
+
+    def drain_rate(self) -> float:
+        """EWMA of entries resolved per second (0.0 until the first two
+        dispatches have completed)."""
+        return self._drain_rate
+
+    def _note_drain(self, n: int) -> None:
+        now = time.monotonic()
+        if self._drained_at is not None:
+            dt = now - self._drained_at
+            if dt > 0:
+                inst = n / dt
+                self._drain_rate = (
+                    inst if self._drain_rate == 0.0
+                    else 0.8 * self._drain_rate + 0.2 * inst
+                )
+        self._drained_at = now
 
     def _set_depth_gauge(self) -> None:
         metrics.gauge("tpu.queue.depth").set(

@@ -24,6 +24,12 @@ from dataclasses import dataclass, field
 
 @dataclass
 class RateLimitSettings:
+    """The GLOBAL token bucket — the aggregate backstop behind the
+    per-client buckets in ``[admission]``.  ``requests_per_minute`` has
+    no "0 disables" semantics here (a server that admits nothing is a
+    misconfiguration): set it very large to effectively disable.  The
+    per-client limits in :class:`AdmissionSettings` use ``0`` = unset."""
+
     requests_per_minute: int = 100
     burst: int = 10
 
@@ -107,6 +113,32 @@ class DurabilitySettings:
 
 
 @dataclass
+class AdmissionSettings:
+    """Adaptive overload control (admission subsystem): per-client keyed
+    token buckets in an LRU-bounded table, DAGOR-style priority-aware
+    shedding driven by live queue signals, and server retry-pushback
+    sizing.  See ``docs/operations.md`` §"Overload & admission"."""
+
+    enabled: bool = True
+    # per-client fair limiting; 0 = DISABLED (the unset state — unlike
+    # the global [rate_limit] bucket, where 0 is invalid)
+    per_client_rpm: int = 0
+    per_client_burst: int = 20
+    max_clients: int = 1024       # LRU bound on the keyed-bucket table
+    # adaptive priority shedding (AIMD on the admission level)
+    high_watermark: float = 0.75  # queue utilization that sheds harder
+    low_watermark: float = 0.50   # utilization below which we re-admit
+    target_queue_wait_ms: float = 50.0  # avg queue_wait that counts as
+                                        # overload even at low depth
+    adjust_interval_ms: float = 100.0   # signal sampling / AIMD cadence
+    increase_step: float = 0.1    # additive level increase per healthy tick
+    decrease_factor: float = 0.5  # multiplicative decrease on overload
+    # server pushback bounds (cpzk-retry-after-ms trailing metadata)
+    retry_after_min_ms: float = 25.0
+    retry_after_max_ms: float = 5000.0
+
+
+@dataclass
 class RetrySettings:
     """Client retry knobs (resilience subsystem): exponential backoff with
     full jitter and a shared retry budget, applied by ``AuthClient`` to
@@ -141,6 +173,7 @@ class ServerConfig:
     # opt-in checkpoint/resume (empty = in-memory only, reference parity)
     state_file: str = ""
     rate_limit: RateLimitSettings = field(default_factory=RateLimitSettings)
+    admission: AdmissionSettings = field(default_factory=AdmissionSettings)
     metrics: MetricsSettings = field(default_factory=MetricsSettings)
     tls: TlsSettings = field(default_factory=TlsSettings)
     tpu: TpuSettings = field(default_factory=TpuSettings)
@@ -175,6 +208,7 @@ class ServerConfig:
             self.state_file = str(data["state_file"])
         for section, obj in (
             ("rate_limit", self.rate_limit),
+            ("admission", self.admission),
             ("metrics", self.metrics),
             ("tls", self.tls),
             ("tpu", self.tpu),
@@ -217,6 +251,31 @@ class ServerConfig:
             self.rate_limit.requests_per_minute = int(v)
         if (v := get_alias("RATE_LIMIT_BURST", "RATE_BURST")) is not None:
             self.rate_limit.burst = int(v)
+        # admission knobs (overload control subsystem)
+        if (v := get("ADMISSION_ENABLED")) is not None:
+            self.admission.enabled = v.lower() in ("1", "true", "yes", "on")
+        if (v := get("ADMISSION_PER_CLIENT_RPM")) is not None:
+            self.admission.per_client_rpm = int(v)
+        if (v := get("ADMISSION_PER_CLIENT_BURST")) is not None:
+            self.admission.per_client_burst = int(v)
+        if (v := get("ADMISSION_MAX_CLIENTS")) is not None:
+            self.admission.max_clients = int(v)
+        if (v := get("ADMISSION_HIGH_WATERMARK")) is not None:
+            self.admission.high_watermark = float(v)
+        if (v := get("ADMISSION_LOW_WATERMARK")) is not None:
+            self.admission.low_watermark = float(v)
+        if (v := get("ADMISSION_TARGET_QUEUE_WAIT_MS")) is not None:
+            self.admission.target_queue_wait_ms = float(v)
+        if (v := get("ADMISSION_ADJUST_INTERVAL_MS")) is not None:
+            self.admission.adjust_interval_ms = float(v)
+        if (v := get("ADMISSION_INCREASE_STEP")) is not None:
+            self.admission.increase_step = float(v)
+        if (v := get("ADMISSION_DECREASE_FACTOR")) is not None:
+            self.admission.decrease_factor = float(v)
+        if (v := get("ADMISSION_RETRY_AFTER_MIN_MS")) is not None:
+            self.admission.retry_after_min_ms = float(v)
+        if (v := get("ADMISSION_RETRY_AFTER_MAX_MS")) is not None:
+            self.admission.retry_after_max_ms = float(v)
         if (v := get_alias("METRICS_ENABLED", "METRICS")) is not None:
             self.metrics.enabled = v.lower() in ("1", "true", "yes", "on")
         if (v := get("METRICS_HOST")) is not None:
@@ -292,10 +351,46 @@ class ServerConfig:
                 )
             if not os.path.exists(self.tls.key_path):
                 raise ValueError(f"TLS key file does not exist: {self.tls.key_path}")
+        # the global bucket has no "0 disables" escape hatch: 0 admits
+        # nothing, and negatives used to slip through silently and refill
+        # the bucket BACKWARDS (satellite fix) — both are now rejected
         if self.rate_limit.requests_per_minute == 0:
             raise ValueError("Rate limit requests_per_minute cannot be zero")
+        if self.rate_limit.requests_per_minute < 0:
+            raise ValueError("Rate limit requests_per_minute cannot be negative")
         if self.rate_limit.burst == 0:
             raise ValueError("Rate limit burst cannot be zero")
+        if self.rate_limit.burst < 0:
+            raise ValueError("Rate limit burst cannot be negative")
+        # per-client limits: 0 = unset/disabled, negative = error
+        if self.admission.per_client_rpm < 0:
+            raise ValueError(
+                "admission.per_client_rpm cannot be negative "
+                "(0 disables per-client limiting)"
+            )
+        if self.admission.per_client_burst < 1:
+            raise ValueError("admission.per_client_burst must be >= 1")
+        if self.admission.max_clients < 1:
+            raise ValueError("admission.max_clients must be >= 1")
+        if not (0.0 < self.admission.low_watermark <= self.admission.high_watermark <= 1.0):
+            raise ValueError(
+                "admission watermarks must satisfy "
+                "0 < low_watermark <= high_watermark <= 1"
+            )
+        if self.admission.target_queue_wait_ms < 0:
+            raise ValueError("admission.target_queue_wait_ms cannot be negative")
+        if self.admission.adjust_interval_ms <= 0:
+            raise ValueError("admission.adjust_interval_ms must be positive")
+        if self.admission.increase_step <= 0:
+            raise ValueError("admission.increase_step must be positive")
+        if not (0.0 < self.admission.decrease_factor < 1.0):
+            raise ValueError("admission.decrease_factor must be in (0, 1)")
+        if not (0.0 <= self.admission.retry_after_min_ms
+                <= self.admission.retry_after_max_ms):
+            raise ValueError(
+                "admission retry_after bounds must satisfy "
+                "0 <= retry_after_min_ms <= retry_after_max_ms"
+            )
         if self.tpu.backend not in ("cpu", "tpu"):
             raise ValueError(f"Unknown verifier backend: {self.tpu.backend}")
         if self.tpu.pipeline_depth < 1:
@@ -379,7 +474,14 @@ def _load_dotenv() -> None:
 
 
 class RateLimitExceeded(Exception):
-    pass
+    """Global-bucket rejection.  ``retry_after_s`` is the time until one
+    token refills — the service layer sizes its ``cpzk-retry-after-ms``
+    pushback from it (every RESOURCE_EXHAUSTED path carries pushback)."""
+
+    def __init__(self, message: str = "Rate limit exceeded",
+                 retry_after_s: float = 0.0):
+        super().__init__(message)
+        self.retry_after_s = retry_after_s
 
 
 class RateLimiter:
@@ -401,4 +503,10 @@ class RateLimiter:
                 self._tokens -= 1.0
                 self._last_update = now
             else:
-                raise RateLimitExceeded("Rate limit exceeded")
+                per_s = self.rate / 60.0
+                raise RateLimitExceeded(
+                    "Rate limit exceeded",
+                    retry_after_s=(
+                        (1.0 - self._tokens) / per_s if per_s > 0 else 1.0
+                    ),
+                )

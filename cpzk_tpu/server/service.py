@@ -17,6 +17,7 @@ import time
 import grpc
 
 from .. import errors
+from ..admission import RETRY_PUSHBACK_KEY, client_key
 from ..core.ristretto import Ristretto255
 from ..core.rng import SecureRng
 from ..core.transcript import Transcript
@@ -35,6 +36,11 @@ MAX_CHALLENGE_ID = 64
 MAX_PROOF_WIRE = 8192
 MAX_BATCH = 1000
 
+#: Pushback advertised on RESOURCE_EXHAUSTED paths that have no better
+#: estimate (no admission controller / no queue signal): one client
+#: backoff's worth, so uninstrumented retry loops still spread out.
+DEFAULT_RETRY_AFTER_S = 0.05
+
 
 class AuthServiceImpl:
     """The five RPCs (service.rs:59-617 twin)."""
@@ -45,11 +51,13 @@ class AuthServiceImpl:
         rate_limiter: RateLimiter,
         backend: VerifierBackend | None = None,
         batcher=None,
+        admission=None,
     ):
         self.state = state
         self.rate_limiter = rate_limiter
         self.backend = backend
         self.batcher = batcher  # DynamicBatcher | None (TPU serving path)
+        self.admission = admission  # AdmissionController | None
         self.pb2 = load_pb2()
         self.rng = SecureRng()
         # inline-verify concurrency: 2 lets one RPC's Python overlap
@@ -60,11 +68,47 @@ class AuthServiceImpl:
 
     # --- helpers ---
 
-    async def _check_rate(self, context) -> None:
+    async def _abort_exhausted(self, context, msg: str, retry_after_s: float):
+        """RESOURCE_EXHAUSTED carrying ``cpzk-retry-after-ms`` trailing
+        metadata (gRFC A6 server pushback) — EVERY shed path goes through
+        here, not only admission rejections, so a bare 'try again
+        whenever' rejection no longer exists."""
+        ms = max(0, int(round(retry_after_s * 1000.0)))
+        md = ((RETRY_PUSHBACK_KEY, str(ms)),)
+        try:
+            await context.abort(
+                grpc.StatusCode.RESOURCE_EXHAUSTED, msg, trailing_metadata=md
+            )
+        except TypeError:  # hand-rolled test context without the kwarg
+            await context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED, msg)
+
+    def _pushback_s(self, default: float = DEFAULT_RETRY_AFTER_S) -> float:
+        """Queue-drain-sized pushback when a controller is wired, else
+        ``default``."""
+        if self.admission is not None:
+            return self.admission.retry_after_s()
+        return default
+
+    async def _admit(self, context, rpc: str) -> None:
+        """Full admission stack for one RPC: the global token bucket
+        (backstop), then the per-client keyed bucket and the adaptive
+        priority threshold.  Rejections abort RESOURCE_EXHAUSTED with
+        retry pushback."""
         try:
             await self.rate_limiter.check_rate_limit()
-        except RateLimitExceeded:
-            await context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED, "Rate limit exceeded")
+        except RateLimitExceeded as e:
+            metrics.counter("admission.shed.global").inc()
+            await self._abort_exhausted(
+                context, "Rate limit exceeded",
+                getattr(e, "retry_after_s", 0.0) or DEFAULT_RETRY_AFTER_S,
+            )
+        if self.admission is None:
+            return
+        rejection = self.admission.admit(rpc, client_key(context))
+        if rejection is not None:
+            await self._abort_exhausted(
+                context, rejection.message, rejection.retry_after_s
+            )
 
     @staticmethod
     async def _validate_user_id(user_id: str, context) -> None:
@@ -115,7 +159,7 @@ class AuthServiceImpl:
 
     @traced_rpc("Register", "auth.register")
     async def register(self, request, context):
-        await self._check_rate(context)
+        await self._admit(context, "Register")
         await self._validate_user_id(request.user_id, context)
 
         if not request.y1 or not request.y2:
@@ -146,7 +190,7 @@ class AuthServiceImpl:
 
     @traced_rpc("RegisterBatch", "auth.register_batch")
     async def register_batch(self, request, context):
-        await self._check_rate(context)
+        await self._admit(context, "RegisterBatch")
 
         n = len(request.user_ids)
         if n == 0:
@@ -207,7 +251,7 @@ class AuthServiceImpl:
 
     @traced_rpc("CreateChallenge", "auth.challenge")
     async def create_challenge(self, request, context):
-        await self._check_rate(context)
+        await self._admit(context, "CreateChallenge")
         await self._validate_user_id(request.user_id, context)
 
         user = await self.state.get_user(request.user_id)
@@ -220,15 +264,17 @@ class AuthServiceImpl:
         try:
             expires_at = await self.state.create_challenge(user.user_id, challenge_id)
         except errors.Error as e:
-            await context.abort(
-                grpc.StatusCode.RESOURCE_EXHAUSTED, f"Challenge creation failed: {e}"
+            # per-user challenge-cap overload: pushback rides along like
+            # every other RESOURCE_EXHAUSTED (satellite fix)
+            await self._abort_exhausted(
+                context, f"Challenge creation failed: {e}", self._pushback_s()
             )
 
         return self.pb2.ChallengeResponse(challenge_id=challenge_id, expires_at=expires_at)
 
     @traced_rpc("VerifyProof", "auth.verify")
     async def verify_proof(self, request, context):
-        await self._check_rate(context)
+        await self._admit(context, "VerifyProof")
         await self._validate_user_id(request.user_id, context)
 
         msg = _proof_args_error(request.challenge_id, request.proof)
@@ -263,8 +309,8 @@ class AuthServiceImpl:
                     trace_id=rctx.trace_id,
                 )
             except batching.QueueFull:
-                await context.abort(
-                    grpc.StatusCode.RESOURCE_EXHAUSTED, "Server overloaded"
+                await self._abort_exhausted(
+                    context, "Server overloaded", self._pushback_s()
                 )
             except batching.DeadlineExceeded:
                 await context.abort(
@@ -299,7 +345,7 @@ class AuthServiceImpl:
 
     @traced_rpc("VerifyProofBatch", "auth.verify_batch")
     async def verify_proof_batch(self, request, context):
-        await self._check_rate(context)
+        await self._admit(context, "VerifyProofBatch")
 
         n = len(request.user_ids)
         if n == 0:
@@ -400,8 +446,8 @@ class AuthServiceImpl:
                         batch_results = await asyncio.to_thread(
                             batch.verify, self.rng)
             except batching.QueueFull:
-                await context.abort(
-                    grpc.StatusCode.RESOURCE_EXHAUSTED, "Server overloaded"
+                await self._abort_exhausted(
+                    context, "Server overloaded", self._pushback_s()
                 )
             except batching.DeadlineExceeded:
                 await context.abort(
@@ -515,6 +561,7 @@ async def serve(
     backend: VerifierBackend | None = None,
     batcher=None,
     tls: tuple[bytes, bytes] | None = None,
+    admission=None,
 ):
     """Build and start an aio server; returns (server, bound_port).
 
@@ -523,14 +570,20 @@ async def serve(
     the transport (SURVEY.md §3.3).  ``batcher`` is an optional started-here
     :class:`~cpzk_tpu.server.batching.DynamicBatcher` routing verification
     through the TPU data plane; it is exposed as ``server.batcher`` so the
-    daemon can drain it on shutdown.
+    daemon can drain it on shutdown.  ``admission`` is an optional
+    :class:`~cpzk_tpu.admission.AdmissionController` gating every RPC
+    (per-client fairness + priority shedding + retry pushback).
     """
     server = grpc.aio.server()
-    service = AuthServiceImpl(state, rate_limiter, backend=backend, batcher=batcher)
+    service = AuthServiceImpl(
+        state, rate_limiter, backend=backend, batcher=batcher,
+        admission=admission,
+    )
     server.add_generic_rpc_handlers((make_generic_handler(service),))
-    health = _add_health_service(server)
+    health = _add_health_service(server, backend=backend)
     server.health = health  # for shutdown: server.health.serving = False
     server.batcher = batcher
+    server.admission = admission
     if batcher is not None:
         batcher.start()
     addr = f"{host}:{port}"
@@ -543,22 +596,54 @@ async def serve(
     return server, bound
 
 
+#: ``HealthCheckRequest.service`` values that select the READINESS view
+#: (the auth service name also works, for LB configs that probe it).
+READINESS_SERVICE = "readiness"
+
+
 class HealthService:
     """Standard gRPC health protocol, hand-wired (tonic-health twin,
-    bin/server.rs:208-211). ``set_serving(False)`` flips the whole server to
-    NOT_SERVING during graceful shutdown (bin/server.rs:420-422)."""
+    bin/server.rs:208-211), split into liveness and readiness views:
 
-    def __init__(self):
+    - ``service=""`` — **liveness**: SERVING while the process is up and
+      not draining (``serving = False`` flips it at graceful shutdown,
+      bin/server.rs:420-422).  An open failover breaker does NOT flip
+      liveness — the CPU fallback still answers correctly.
+    - ``service="readiness"`` (or the auth service name) — **readiness**:
+      additionally NOT_SERVING while WAL recovery/replay is still running
+      (``recovering``) and while the failover breaker holds the backend
+      degraded, so load balancers stop routing to a replica that would
+      only shed or answer at fallback speed, without restart-looping it.
+    """
+
+    def __init__(self, backend=None):
         from .proto import load_health_pb2
 
         self.pb2 = load_health_pb2()
         self.serving = True
+        #: True while boot-time WAL recovery/replay runs (set by whoever
+        #: drives recovery with the listener already up; the stock daemon
+        #: recovers before binding, where "not ready" is simply
+        #: connection-refused).
+        self.recovering = False
+        self.backend = backend  # FailoverBackend | None
+
+    def _ready(self) -> bool:
+        if not self.serving or self.recovering:
+            return False
+        backend = self.backend
+        return not (backend is not None and getattr(backend, "degraded", False))
 
     async def check(self, request, context):
         del context
         st = self.pb2.HealthCheckResponse.ServingStatus
+        service = getattr(request, "service", "") or ""
+        if service in (READINESS_SERVICE, SERVICE_NAME):
+            ok = self._ready()
+        else:
+            ok = self.serving
         return self.pb2.HealthCheckResponse(
-            status=st.SERVING if self.serving else st.NOT_SERVING
+            status=st.SERVING if ok else st.NOT_SERVING
         )
 
     def handler(self) -> grpc.GenericRpcHandler:
@@ -574,7 +659,7 @@ class HealthService:
         )
 
 
-def _add_health_service(server) -> "HealthService":
-    health = HealthService()
+def _add_health_service(server, backend=None) -> "HealthService":
+    health = HealthService(backend=backend)
     server.add_generic_rpc_handlers((health.handler(),))
     return health

@@ -1,0 +1,619 @@
+"""Admission subsystem: per-client fair limiting, priority-aware adaptive
+shedding, server retry-pushback, and the readiness split.
+
+Unit layers use injected clocks and signal providers, so every AIMD move
+is exact; the gRPC layers assert the wire contract — every
+RESOURCE_EXHAUSTED carries ``cpzk-retry-after-ms`` trailing metadata, and
+the client retry policy sleeps exactly the advertised pushback instead of
+its own jitter (gRFC A6).  The overload-storm acceptance scenario lives
+in ``tests/test_chaos.py``.
+"""
+
+import asyncio
+import dataclasses
+import pathlib
+import random
+import re
+import types
+
+import grpc
+import pytest
+
+from cpzk_tpu.admission import (
+    MIN_LEVEL,
+    RETRY_PUSHBACK_KEY,
+    AdmissionController,
+    KeyedTokenBuckets,
+    classify,
+    client_key,
+)
+from cpzk_tpu.client import AuthClient
+from cpzk_tpu.resilience.retry import MAX_PUSHBACK_S, RetryBudget, RetryPolicy
+from cpzk_tpu.server import RateLimiter, ServerState, metrics
+from cpzk_tpu.server.config import AdmissionSettings, ServerConfig
+from cpzk_tpu.server.service import serve
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+# --- keyed token buckets -----------------------------------------------------
+
+
+def test_keyed_buckets_admit_burst_then_throttle_and_refill():
+    t = [0.0]
+    kb = KeyedTokenBuckets(60, burst=3, max_keys=8, clock=lambda: t[0])
+    assert [kb.check("a") for _ in range(3)] == [None] * 3
+    retry = kb.check("a")
+    assert retry is not None and retry == pytest.approx(1.0)
+    # another key is unaffected — fairness is the whole point
+    assert kb.check("b") is None
+    # refill at 1 token/s
+    t[0] = 1.0
+    assert kb.check("a") is None
+    assert kb.check("a") is not None
+
+
+def test_keyed_buckets_lru_bound_and_disabled_mode():
+    t = [0.0]
+    kb = KeyedTokenBuckets(60, burst=1, max_keys=4, clock=lambda: t[0])
+    for i in range(100):
+        kb.check(f"key-{i}")
+    assert len(kb) == 4
+    assert kb.evictions == 96
+    # most-recently-seen keys survive
+    kb2 = KeyedTokenBuckets(60, burst=5, max_keys=2, clock=lambda: t[0])
+    kb2.check("old"), kb2.check("mid"), kb2.check("old"), kb2.check("new")
+    assert len(kb2) == 2
+    assert kb2.check("old") is None  # still tracked (burst not exhausted)
+
+    # rpm=0: disabled — admits everything, allocates nothing
+    off = KeyedTokenBuckets(0, burst=1, max_keys=2, clock=lambda: t[0])
+    for i in range(50):
+        assert off.check(f"k{i}") is None
+    assert len(off) == 0 and not off.enabled
+
+
+def test_client_key_prefers_metadata_tag_then_peer_host():
+    class Ctx:
+        def __init__(self, md, peer):
+            self._md, self._peer = md, peer
+
+        def invocation_metadata(self):
+            return self._md
+
+        def peer(self):
+            return self._peer
+
+    assert client_key(Ctx([("cpzk-client-id", "alice")], "ipv4:1.2.3.4:55")) == "id:alice"
+    assert client_key(Ctx([("CPZK-Client-Id", b"bob")], "")) == "id:bob"
+    # peer fallback strips the ephemeral port: connection churn must not
+    # mint fresh buckets
+    assert client_key(Ctx([], "ipv4:1.2.3.4:55001")) == "peer:ipv4:1.2.3.4"
+    assert client_key(Ctx([], "ipv6:[::1]:55001")) == "peer:ipv6:[::1]"
+    assert client_key(Ctx([], "unix:/tmp/s.sock")) == "peer:unix:/tmp/s.sock"
+    # hostile metadata is truncated, never raises
+    key = client_key(Ctx([("cpzk-client-id", "x" * 4096)], ""))
+    assert len(key) <= 128
+    assert client_key(object()) == "peer:unknown"
+
+
+# --- classification + adaptive controller ------------------------------------
+
+
+def test_classify_tiers_and_totality():
+    assert classify("VerifyProof") == 0 == classify("VerifyProofBatch")
+    assert classify("CreateChallenge") == 1
+    assert classify("Register") == 2 == classify("RegisterBatch")
+    for junk in ("", "Nope", None, 42, b"\xff\x00", object()):
+        assert classify(junk) == 2  # unknown -> lowest priority, no raise
+
+
+def _controller(signals, clock, **kw):
+    kw.setdefault("per_client_rpm", 0)
+    kw.setdefault("adjust_interval_ms", 10.0)
+    kw.setdefault("increase_step", 0.5)
+    kw.setdefault("decrease_factor", 0.5)
+    return AdmissionController(
+        AdmissionSettings(**kw), clock=clock, signals=signals
+    )
+
+
+def test_aimd_sheds_lowest_tier_first_and_recovers():
+    t = [0.0]
+    sig = [(0.0, 0.0)]
+    c = _controller(lambda: sig[0], lambda: t[0])
+    assert c.level == 3.0
+    # healthy: everything admitted
+    for rpc in ("Register", "CreateChallenge", "VerifyProof"):
+        assert c.admit(rpc, "k") is None
+
+    # overload tick 1: 3.0 -> 1.5, register sheds, challenge+verify pass
+    sig[0] = (0.95, 0.0)
+    t[0] += 0.011
+    r = c.admit("Register", "k")
+    assert r is not None and r.reason == "priority" and c.level == 1.5
+    assert c.admit("CreateChallenge", "k") is None
+    assert c.admit("VerifyProof", "k") is None
+
+    # overload tick 2: 1.5 -> floor 1.0, challenge sheds too, verify NEVER
+    t[0] += 0.011
+    r = c.admit("CreateChallenge", "k")
+    assert r is not None and r.reason == "priority" and c.level == MIN_LEVEL
+    for _ in range(5):
+        t[0] += 0.011
+        assert c.admit("VerifyProof", "k") is None  # floor holds forever
+    assert c.level == MIN_LEVEL
+
+    # recovery: additive climb at increase_step per healthy tick
+    sig[0] = (0.1, 0.0)
+    t[0] += 0.011
+    c.admit("VerifyProof", "k")
+    assert c.level == pytest.approx(1.5)
+    t[0] += 0.011
+    c.admit("CreateChallenge", "k")  # 1.5 -> 2.0 then tier1 < 2.0 admitted
+    assert c.level == pytest.approx(2.0)
+    # same interval (no clock advance): tier2 not yet readmitted at 2.0
+    assert c.admit("Register", "k") is not None
+    t[0] += 0.011
+    assert c.admit("Register", "k") is None  # level 2.5: tier2 back
+    assert c.level == pytest.approx(2.5)
+    t[0] += 0.011
+    c.admit("Register", "k")
+    assert c.level == pytest.approx(3.0)  # fully recovered, capped at 3
+
+
+def test_queue_wait_signal_alone_triggers_shedding():
+    t = [0.0]
+    sig = [(0.0, 0.0)]
+    c = _controller(lambda: sig[0], lambda: t[0], target_queue_wait_ms=50.0)
+    sig[0] = (0.0, 0.2)  # low depth, but 200ms avg queue wait
+    t[0] += 0.011
+    r = c.admit("Register", "k")
+    assert r is not None and r.reason == "priority"
+
+
+def test_hysteresis_band_freezes_level():
+    t = [0.0]
+    sig = [(0.6, 0.0)]  # between low (0.5) and high (0.75) watermarks
+    c = _controller(lambda: sig[0], lambda: t[0])
+    c.level = 2.0
+    for _ in range(5):
+        t[0] += 0.011
+        c.admit("VerifyProof", "k")
+    assert c.level == 2.0  # neither overloaded nor healthy: no movement
+
+
+def test_per_client_bucket_checked_before_priority():
+    t = [0.0]
+    c = _controller(
+        lambda: (0.0, 0.0), lambda: t[0],
+        per_client_rpm=60, per_client_burst=1,
+    )
+    assert c.admit("VerifyProof", "hot") is None
+    r = c.admit("VerifyProof", "hot")
+    assert r is not None and r.reason == "per_client"
+    assert r.retry_after_s >= c.settings.retry_after_min_ms / 1000.0
+    assert c.admit("VerifyProof", "cold") is None  # others unaffected
+
+
+def test_retry_after_sized_from_drain_rate():
+    class FakeBatcher:
+        window = 0.005
+        max_batch = 64
+
+        def __init__(self, depth, cap, rate):
+            self._snap, self._rate = (depth, cap), rate
+
+        def load_snapshot(self):
+            return self._snap
+
+        def drain_rate(self):
+            return self._rate
+
+    t = [0.0]
+    s = AdmissionSettings(retry_after_min_ms=10, retry_after_max_ms=2000)
+    # 100 queued, draining 200/s -> 500ms
+    c = AdmissionController(s, batcher=FakeBatcher(100, 256, 200.0),
+                            clock=lambda: t[0], signals=lambda: (0, 0))
+    assert c.retry_after_s() == pytest.approx(0.5)
+    # clamped into [min, max]
+    c = AdmissionController(s, batcher=FakeBatcher(1, 256, 1e6),
+                            clock=lambda: t[0], signals=lambda: (0, 0))
+    assert c.retry_after_s() == pytest.approx(0.010)
+    c = AdmissionController(s, batcher=FakeBatcher(10**6, 256, 1.0),
+                            clock=lambda: t[0], signals=lambda: (0, 0))
+    assert c.retry_after_s() == pytest.approx(2.0)
+    # no batcher: the configured floor
+    c = AdmissionController(s, clock=lambda: t[0], signals=lambda: (0, 0))
+    assert c.retry_after_s() == pytest.approx(0.010)
+
+
+# --- retry pushback (gRFC A6) ------------------------------------------------
+
+
+def test_policy_sleep_prefers_pushback_over_jitter():
+    pol = RetryPolicy(initial_backoff_s=0.05, max_backoff_s=1.0)
+    rng = random.Random(0)
+    # pushback overrides the computed jitter exactly
+    assert pol.sleep_s(1, pushback_ms=123.0, rng=rng) == pytest.approx(0.123)
+    assert pol.sleep_s(5, pushback_ms=0.0, rng=rng) == 0.0
+    # hostile pushback is capped
+    assert pol.sleep_s(1, pushback_ms=10**9, rng=rng) == MAX_PUSHBACK_S
+    # absent pushback falls back to full jitter within the attempt cap
+    for _ in range(50):
+        assert 0.0 <= pol.sleep_s(1, rng=rng) <= 0.05
+
+
+class PushbackRpcError(grpc.RpcError):
+    def __init__(self, code, trailing=()):
+        self._code, self._trailing = code, trailing
+
+    def code(self):
+        return self._code
+
+    def trailing_metadata(self):
+        return self._trailing
+
+
+def _sleep_recorder(monkeypatch, module):
+    sleeps = []
+
+    async def fake_sleep(s):
+        sleeps.append(s)
+
+    monkeypatch.setattr(
+        module, "asyncio",
+        types.SimpleNamespace(sleep=fake_sleep),
+    )
+    return sleeps
+
+
+def test_client_honors_pushback_and_budget(monkeypatch):
+    import cpzk_tpu.client.rpc as rpc_mod
+
+    sleeps = _sleep_recorder(monkeypatch, rpc_mod)
+
+    async def main():
+        state = ServerState()
+        server, port = await serve(state, RateLimiter(10_000, 10_000), port=0)
+        try:
+            client = AuthClient(
+                f"127.0.0.1:{port}",
+                retry=RetryPolicy(
+                    max_attempts=4, initial_backoff_s=5.0, max_backoff_s=9.0
+                ),
+                retry_rng=random.Random(1),
+            )
+            async with client:
+                calls = {"n": 0}
+                md = ((RETRY_PUSHBACK_KEY, "217"),)
+
+                async def shed_twice(request, timeout=None, metadata=None):
+                    calls["n"] += 1
+                    if calls["n"] <= 2:
+                        raise PushbackRpcError(
+                            grpc.StatusCode.RESOURCE_EXHAUSTED, md
+                        )
+                    return object()
+
+                client._stubs["CreateChallenge"] = shed_twice
+                await client.create_challenge("someone")
+                # the sleeps are EXACTLY the advertised pushback — with
+                # jitter they would be uniform on [0, 5s]/[0, 9s]
+                assert sleeps == [0.217, 0.217]
+                assert calls["n"] == 3
+
+                # negative pushback: server said do not retry
+                calls["n"] = 0
+                sleeps.clear()
+
+                async def shed_forever(request, timeout=None, metadata=None):
+                    calls["n"] += 1
+                    raise PushbackRpcError(
+                        grpc.StatusCode.RESOURCE_EXHAUSTED,
+                        ((RETRY_PUSHBACK_KEY, "-1"),),
+                    )
+
+                client._stubs["CreateChallenge"] = shed_forever
+                with pytest.raises(grpc.RpcError):
+                    await client.create_challenge("someone")
+                assert calls["n"] == 1 and sleeps == []
+
+                # pushback does NOT bypass the retry budget
+                calls["n"] = 0
+                sleeps.clear()
+                client.retry = RetryPolicy(
+                    max_attempts=10,
+                    initial_backoff_s=0.001, max_backoff_s=0.002,
+                    budget=RetryBudget(tokens=2.0, token_ratio=0.0),
+                )
+
+                async def shed_with_pushback(request, timeout=None, metadata=None):
+                    calls["n"] += 1
+                    raise PushbackRpcError(
+                        grpc.StatusCode.RESOURCE_EXHAUSTED, md
+                    )
+
+                client._stubs["CreateChallenge"] = shed_with_pushback
+                with pytest.raises(grpc.RpcError):
+                    await client.create_challenge("someone")
+                assert calls["n"] == 3  # initial + 2 budgeted retries
+                assert sleeps == [0.217, 0.217]
+        finally:
+            await server.stop(None)
+
+    run(main())
+
+
+def test_every_resource_exhausted_path_carries_pushback():
+    """Satellite: the global rate limit (and by the same helper, the
+    challenge-cap and queue-full paths) attaches cpzk-retry-after-ms."""
+
+    async def main():
+        state = ServerState()
+        # burst 1: the second immediate RPC trips the global bucket
+        server, port = await serve(state, RateLimiter(60, 1), port=0)
+        try:
+            async with AuthClient(f"127.0.0.1:{port}") as client:
+                # first call consumes the only token (NOT_FOUND is fine —
+                # admission happens before the user lookup)
+                with pytest.raises(grpc.RpcError):
+                    await client.create_challenge("nobody")
+                try:
+                    await client.create_challenge("nobody")
+                    raise AssertionError("expected RESOURCE_EXHAUSTED")
+                except grpc.RpcError as e:
+                    assert e.code() == grpc.StatusCode.RESOURCE_EXHAUSTED
+                    trailing = dict(
+                        (str(k).lower(), v) for k, v in e.trailing_metadata()
+                    )
+                    assert RETRY_PUSHBACK_KEY in trailing
+                    assert float(trailing[RETRY_PUSHBACK_KEY]) >= 0
+        finally:
+            await server.stop(None)
+
+    run(main())
+
+
+def test_admission_rejection_carries_pushback_over_grpc():
+    async def main():
+        state = ServerState()
+        controller = AdmissionController(
+            AdmissionSettings(per_client_rpm=60, per_client_burst=1)
+        )
+        server, port = await serve(
+            state, RateLimiter(10_000, 10_000), port=0, admission=controller
+        )
+        try:
+            async with AuthClient(
+                f"127.0.0.1:{port}", client_id="hot-client"
+            ) as client:
+                with pytest.raises(grpc.RpcError):  # NOT_FOUND, admitted
+                    await client.create_challenge("nobody")
+                try:
+                    await client.create_challenge("nobody")
+                    raise AssertionError("expected RESOURCE_EXHAUSTED")
+                except grpc.RpcError as e:
+                    assert e.code() == grpc.StatusCode.RESOURCE_EXHAUSTED
+                    assert "Per-client" in e.details()
+                    trailing = dict(
+                        (str(k).lower(), v) for k, v in e.trailing_metadata()
+                    )
+                    assert float(trailing[RETRY_PUSHBACK_KEY]) >= 0
+                # the metadata tag keyed the bucket: same host, different
+                # id, fresh bucket
+                async with AuthClient(
+                    f"127.0.0.1:{port}", client_id="polite-client"
+                ) as other:
+                    with pytest.raises(grpc.RpcError) as ei:
+                        await other.create_challenge("nobody")
+                    assert ei.value.code() == grpc.StatusCode.NOT_FOUND
+        finally:
+            await server.stop(None)
+
+    run(main())
+
+
+# --- readiness split ---------------------------------------------------------
+
+
+def test_readiness_not_serving_while_degraded_or_recovering():
+    from cpzk_tpu.protocol.batch import CpuBackend, FailoverBackend
+    from cpzk_tpu.resilience.faults import FaultInjectionBackend, FaultPlan
+    from cpzk_tpu.server.proto import load_health_pb2
+
+    async def main():
+        hpb2 = load_health_pb2()
+        SERVING = hpb2.HealthCheckResponse.ServingStatus.SERVING
+        NOT_SERVING = hpb2.HealthCheckResponse.ServingStatus.NOT_SERVING
+
+        backend = FailoverBackend(
+            FaultInjectionBackend(CpuBackend(), FaultPlan().fail_after(0)),
+            CpuBackend(), recovery_after_s=None,
+        )
+        state = ServerState()
+        server, port = await serve(
+            state, RateLimiter(10_000, 10_000), port=0, backend=backend
+        )
+        try:
+            async with AuthClient(f"127.0.0.1:{port}") as client:
+                # healthy boot: both views SERVING
+                assert (await client.health_check()).status == SERVING
+                assert (
+                    await client.health_check(service="readiness")
+                ).status == SERVING
+
+                # WAL recovery in flight: readiness drops, liveness stays
+                server.health.recovering = True
+                assert (await client.health_check()).status == SERVING
+                assert (
+                    await client.health_check(service="readiness")
+                ).status == NOT_SERVING
+                server.health.recovering = False
+
+                # breaker open: readiness drops, liveness stays (the
+                # fallback still answers — do not restart-loop it)
+                backend.breaker.record_failure()
+                assert backend.degraded
+                assert (await client.health_check()).status == SERVING
+                assert (
+                    await client.health_check(service="readiness")
+                ).status == NOT_SERVING
+                # the auth service name selects the readiness view too
+                assert (
+                    await client.health_check(service="auth.AuthService")
+                ).status == NOT_SERVING
+
+                # operator re-arm: readiness returns
+                backend.reset()
+                assert (
+                    await client.health_check(service="readiness")
+                ).status == SERVING
+
+                # graceful drain flips BOTH views
+                server.health.serving = False
+                assert (await client.health_check()).status == NOT_SERVING
+                assert (
+                    await client.health_check(service="readiness")
+                ).status == NOT_SERVING
+        finally:
+            await server.stop(None)
+
+    run(main())
+
+
+# --- REPL /overload ----------------------------------------------------------
+
+
+def test_overload_repl_command():
+    from cpzk_tpu.server.__main__ import handle_command
+
+    async def main():
+        state = ServerState()
+        out, quit_ = await handle_command("/overload", state, None, None, None)
+        assert "admission control disabled" in out and not quit_
+
+        t = [0.0]
+        c = _controller(lambda: (0.0, 0.0), lambda: t[0],
+                        per_client_rpm=60, per_client_burst=1)
+        c.admit("VerifyProof", "a")
+        c.admit("VerifyProof", "a")  # second one: per-client shed
+        out, quit_ = await handle_command(
+            "/ov", state, None, None, c
+        )
+        assert not quit_
+        assert "level=3.00/3" in out
+        assert "admitting=verify+challenge+register" in out
+        assert "clients=1/1024" in out
+        assert re.search(r"shed\{client=\d+ priority=\d+ global=\d+\}", out)
+
+    run(main())
+
+
+# --- config: layering, validation, drift guard -------------------------------
+
+
+def test_admission_config_layering_and_validation(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    cfg = ServerConfig.from_env()
+    assert cfg.admission.enabled is True
+    assert cfg.admission.per_client_rpm == 0  # 0 = disabled (unset)
+    cfg.validate()  # defaults are valid
+
+    (tmp_path / "server.toml").write_text(
+        "[admission]\nper_client_rpm = 120\nmax_clients = 64\n"
+        "decrease_factor = 0.25\n"
+    )
+    monkeypatch.setenv("SERVER_CONFIG_PATH", str(tmp_path / "server.toml"))
+    cfg = ServerConfig.from_env()
+    assert cfg.admission.per_client_rpm == 120
+    assert cfg.admission.max_clients == 64
+    assert cfg.admission.decrease_factor == 0.25
+    cfg.validate()
+    # env overrides TOML
+    monkeypatch.setenv("SERVER_ADMISSION_PER_CLIENT_RPM", "30")
+    monkeypatch.setenv("SERVER_ADMISSION_HIGH_WATERMARK", "0.9")
+    monkeypatch.setenv("SERVER_ADMISSION_ENABLED", "false")
+    cfg = ServerConfig.from_env()
+    assert cfg.admission.per_client_rpm == 30
+    assert cfg.admission.high_watermark == 0.9
+    assert cfg.admission.enabled is False
+
+    def invalid(**kw):
+        bad = ServerConfig()
+        for key, value in kw.items():
+            setattr(bad.admission, key, value)
+        with pytest.raises(ValueError, match="admission"):
+            bad.validate()
+
+    invalid(per_client_rpm=-1)
+    invalid(per_client_burst=0)
+    invalid(max_clients=0)
+    invalid(low_watermark=0.8, high_watermark=0.5)
+    invalid(high_watermark=1.5)
+    invalid(target_queue_wait_ms=-1)
+    invalid(adjust_interval_ms=0)
+    invalid(increase_step=0)
+    invalid(decrease_factor=1.0)
+    invalid(retry_after_min_ms=100, retry_after_max_ms=50)
+
+
+def test_rate_limit_validation_rejects_negatives():
+    """Satellite fix: negative requests_per_minute / burst used to slip
+    through validation (and refill the bucket backwards)."""
+    for field, value, match in (
+        ("requests_per_minute", 0, "cannot be zero"),
+        ("requests_per_minute", -5, "cannot be negative"),
+        ("burst", 0, "cannot be zero"),
+        ("burst", -1, "cannot be negative"),
+    ):
+        bad = ServerConfig()
+        setattr(bad.rate_limit, field, value)
+        with pytest.raises(ValueError, match=match):
+            bad.validate()
+
+
+def test_admission_config_keys_documented():
+    """CI drift guard (pattern from test_durability.py): every [admission]
+    knob ships in the TOML example, the .env example, and the
+    operations-doc knob inventory."""
+    keys = [f.name for f in dataclasses.fields(AdmissionSettings)]
+    assert keys  # the guard itself must not silently go vacuous
+
+    toml_text = (ROOT / "config" / "server.toml.example").read_text()
+    m = re.search(r"^\[admission\]$", toml_text, re.M)
+    assert m, "[admission] section missing from config/server.toml.example"
+    section = toml_text[m.end():].split("\n[", 1)[0]
+    env_text = (ROOT / ".env.example").read_text()
+    docs = (ROOT / "docs" / "operations.md").read_text()
+    for key in keys:
+        assert re.search(rf"^{key}\s*=", section, re.M), (
+            f"[admission] key {key!r} missing from config/server.toml.example"
+        )
+        assert f"SERVER_ADMISSION_{key.upper()}" in env_text, (
+            f"SERVER_ADMISSION_{key.upper()} missing from .env.example"
+        )
+        assert f"`admission.{key}`" in docs, (
+            f"`admission.{key}` missing from the docs/operations.md "
+            "knob inventory"
+        )
+
+
+def test_admission_metrics_registered_and_typed():
+    # touching the controller registers the admission families; the
+    # process-wide inventory guard (test_metrics_inventory) then keeps
+    # them documented
+    t = [0.0]
+    c = _controller(lambda: (0.0, 0.0), lambda: t[0],
+                    per_client_rpm=60, per_client_burst=1)
+    before = metrics.read("admission.admitted")
+    c.admit("VerifyProof", "m1")
+    c.admit("VerifyProof", "m1")
+    assert metrics.read("admission.admitted") - before == 1.0
+    assert metrics.read("admission.shed.per_client") >= 1.0
+    assert metrics.read("admission.level", kind="g") >= MIN_LEVEL
+    assert metrics.read("admission.clients", kind="g") >= 1.0

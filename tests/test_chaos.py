@@ -772,6 +772,175 @@ def test_client_without_policy_never_retries():
     run(main())
 
 
+# --- overload storm: fair admission + priority shedding ----------------------
+
+
+def test_overload_storm_fairness_and_priority_ordering():
+    """Acceptance (admission subsystem): one hot client floods a server
+    whose backend is slowed by fault injection while well-behaved clients
+    run normal login flows.  The hot client is throttled FIRST (its own
+    keyed bucket, not the shared one), well-behaved goodput stays at 100%
+    of fair share (>= the 50% floor), every shed carries retry pushback,
+    and under forced overload the priority ordering holds: registrations
+    and challenges shed while VerifyProof still authenticates."""
+    from cpzk_tpu.admission import AdmissionController, RETRY_PUSHBACK_KEY
+    from cpzk_tpu.server.config import AdmissionSettings
+
+    plan = FaultPlan(seed=11).latency(0.02, every=1)  # every batch slowed
+    backend = FaultInjectionBackend(CpuBackend(), plan)
+    settings = AdmissionSettings(
+        per_client_rpm=60, per_client_burst=5,  # ~5-6 admits per burst
+        adjust_interval_ms=20.0,
+        increase_step=1.0, decrease_factor=0.5,
+    )
+
+    async def main():
+        rng = SecureRng()
+        params = Parameters.new()
+        state = ServerState()
+        batcher = DynamicBatcher(backend, max_batch=8, window_ms=5.0)
+        controller = AdmissionController(settings, batcher=batcher)
+        server, port = await serve(
+            state, RateLimiter(1_000_000, 1_000_000), port=0,
+            backend=backend, batcher=batcher, admission=controller,
+        )
+        try:
+            # --- phase 1: the storm.  4 well-behaved clients each run a
+            # full login flow (3 RPCs, under their burst) while one hot
+            # client fires 60 concurrent RPCs (~12x its fair burst).
+            good = [
+                AuthClient(f"127.0.0.1:{port}", client_id=f"good-{i}")
+                for i in range(4)
+            ]
+            hot = AuthClient(f"127.0.0.1:{port}", client_id="hot")
+
+            async def good_flow(i, client):
+                cid, pf = await _register_and_prove(
+                    client, f"fair-user{i}", rng, params
+                )
+                return await client.verify_proof(f"fair-user{i}", cid, pf)
+
+            async def hot_call():
+                try:
+                    await hot.create_challenge("no-such-user")
+                    return "admitted"
+                except grpc.RpcError as e:
+                    if e.code() == grpc.StatusCode.RESOURCE_EXHAUSTED:
+                        trailing = {
+                            str(k).lower(): v
+                            for k, v in (e.trailing_metadata() or ())
+                        }
+                        assert RETRY_PUSHBACK_KEY in trailing, (
+                            "shed without retry pushback"
+                        )
+                        assert float(trailing[RETRY_PUSHBACK_KEY]) >= 0
+                        return "shed"
+                    assert e.code() == grpc.StatusCode.NOT_FOUND
+                    return "admitted"
+
+            results = await asyncio.gather(
+                *[good_flow(i, c) for i, c in enumerate(good)],
+                *[hot_call() for _ in range(60)],
+            )
+            good_resps, hot_outcomes = results[:4], results[4:]
+
+            # well-behaved goodput: 100% of fair share (>= the 50% floor)
+            assert all(r.success for r in good_resps)
+            # the hot client was throttled, and throttled FIRST: its own
+            # bucket shed it while every well-behaved RPC was admitted
+            shed = hot_outcomes.count("shed")
+            assert shed >= 40, hot_outcomes
+            assert hot_outcomes.count("admitted") <= 20
+            assert metrics.read("admission.shed.per_client") >= shed
+            assert controller.level == pytest.approx(3.0)  # storm never
+            # pushed the queue into overload: priority tier untouched
+
+            # --- phase 2: priority ordering under forced overload.  A
+            # pre-minted challenge must still verify while registrations
+            # and challenge-creation shed.  Each assertion uses a FRESH
+            # client id so the per-client buckets stay out of the way —
+            # what's under test here is the adaptive tier alone.
+            async with AuthClient(
+                f"127.0.0.1:{port}", client_id="probe-setup"
+            ) as setup:
+                cid, pf = await _register_and_prove(
+                    setup, "probe-user", rng, params
+                )
+            controller._signals = lambda: (0.95, 0.5)  # saturate
+            async with AuthClient(
+                f"127.0.0.1:{port}", client_id="probe-driver"
+            ) as driver:
+                deadline = time.monotonic() + 5.0
+                while (
+                    controller.level > 1.0 and time.monotonic() < deadline
+                ):
+                    await asyncio.sleep(0.025)
+                    try:  # any RPC drives an AIMD adjustment
+                        await driver.create_challenge("probe-user")
+                    except grpc.RpcError:
+                        pass
+            assert controller.level == 1.0  # maximum shed
+
+            async with AuthClient(
+                f"127.0.0.1:{port}", client_id="probe-check"
+            ) as probe:
+                with pytest.raises(grpc.RpcError) as ei:
+                    await probe.register(
+                        *(await _statement_wire("probe-y", rng, params))
+                    )
+                assert ei.value.code() == grpc.StatusCode.RESOURCE_EXHAUSTED
+                assert "register" in ei.value.details()
+                with pytest.raises(grpc.RpcError) as ei:
+                    await probe.create_challenge("probe-user")
+                assert ei.value.code() == grpc.StatusCode.RESOURCE_EXHAUSTED
+                assert "challenge" in ei.value.details()
+                # ... but the in-flight login still completes: VerifyProof
+                # is never rejected while lower tiers are being shed
+                resp = await probe.verify_proof("probe-user", cid, pf)
+                assert resp.success and resp.session_token
+                assert metrics.read("admission.shed.priority") >= 2.0
+
+                # --- phase 3: recovery.  Healthy signals re-admit tiers
+                # bottom-up (additive increase), register last.
+                controller._signals = lambda: (0.0, 0.0)
+                deadline = time.monotonic() + 5.0
+                while (
+                    controller.level < 3.0 and time.monotonic() < deadline
+                ):
+                    await asyncio.sleep(0.025)
+                    try:
+                        await probe.verify_proof("probe-user", cid, pf)
+                    except grpc.RpcError:
+                        pass
+                assert controller.level == pytest.approx(3.0)
+                async with AuthClient(
+                    f"127.0.0.1:{port}", client_id="probe-final"
+                ) as fresh:
+                    resp = await fresh.register(
+                        *(await _statement_wire("probe-z", rng, params))
+                    )
+                    assert resp.success
+        finally:
+            for c in good:
+                await c.close()
+            await hot.close()
+            await batcher.stop()
+            await server.stop(None)
+
+    run(main())
+
+
+async def _statement_wire(user, rng, params):
+    """(user_id, y1_wire, y2_wire) for a fresh keypair."""
+    prover = Prover(params, Witness(Ristretto255.random_scalar(rng)))
+    st = prover.statement
+    return (
+        user,
+        Ristretto255.element_to_bytes(st.y1),
+        Ristretto255.element_to_bytes(st.y2),
+    )
+
+
 # --- the full acceptance scenario --------------------------------------------
 
 

@@ -37,6 +37,7 @@ def _load(name: str):
         ("fuzz_proof_deserialization", 120),
         ("fuzz_statement_validation", 400),
         ("fuzz_wal_replay", 300),
+        ("fuzz_admission", 400),
     ],
 )
 def test_fuzz_target_smoke(target, runs):
