@@ -80,7 +80,7 @@ def main() -> None:
     results = []
     for n in sizes:
         # individual: n full verify_with_transcript passes
-        def individual():
+        def individual(n=n):
             for st, pr, ctx in rows[:n]:
                 t = Transcript()
                 t.append_context(ctx)
@@ -89,7 +89,7 @@ def main() -> None:
         results.append(("individual", "host", n, best_of(individual)))
 
         for bname, backend in backends:
-            def batched():
+            def batched(n=n, backend=backend):
                 bv = BatchVerifier(backend=backend)
                 for st, pr, ctx in rows[:n]:
                     bv.add_with_context(params, st, pr, ctx)
@@ -101,7 +101,7 @@ def main() -> None:
 
         # mixed validity: one mismatched row forces the fallback pass
         if n >= 2:
-            def mixed():
+            def mixed(n=n):
                 bv = BatchVerifier()
                 for st, pr, ctx in rows[: n - 1]:
                     bv.add_with_context(params, st, pr, ctx)

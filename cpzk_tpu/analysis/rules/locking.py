@@ -1,0 +1,204 @@
+"""LOCK-001: ServerState registry mutations stay inside the state lock.
+
+``ServerState`` deliberately guards all five maps with ONE asyncio lock
+(see its module docstring — the reference's five RwLocks deadlock under
+inconsistent ordering).  That design only holds if every mutation site
+actually takes the lock; Rust's ``MutexGuard`` proves it in types, here
+it is one forgotten ``async with self._lock`` away from a lost update.
+This rule walks every method of any class named ``ServerState`` (real or
+fixture) and flags mutations of the protected maps — and WAL appends,
+whose ordering contract is "append under the state lock" — that are not
+lexically inside a ``with self._lock`` block.
+
+``__init__`` is exempt (the instance is not yet shared).  The documented
+single-threaded boot path (``replay_journal_record``) carries an inline
+waiver with its reason rather than an engine special case.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..engine import Finding, Module, Rule, register
+
+#: The five registries the state lock guards, plus the journal hook.
+PROTECTED_ATTRS = frozenset({
+    "_users", "_sessions", "_challenges", "_user_challenges",
+    "_user_sessions",
+})
+#: Container methods that mutate in place.
+MUTATORS = frozenset({
+    "pop", "popitem", "setdefault", "clear", "update", "append", "remove",
+    "extend", "insert", "add", "discard",
+})
+#: The maps whose .get()/.setdefault() hand back a *mutable member list*
+#: — an alias to protected state, unlike the dataclass values in _users.
+CONTAINER_MAPS = frozenset({"_user_challenges", "_user_sessions"})
+#: Journal-append calls (WAL order must equal application order, which
+#: only holds when the append happens under the state lock).
+JOURNAL_CALLS = frozenset({"_journal_append"})
+
+
+def _is_self_attr(node: ast.expr, attrs: frozenset[str]) -> bool:
+    return (
+        isinstance(node, ast.Attribute)
+        and node.attr in attrs
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    )
+
+
+def _is_lock_expr(node: ast.expr) -> bool:
+    """``self._lock`` (or anything ending ._lock on self)."""
+    return (
+        isinstance(node, ast.Attribute)
+        and node.attr.endswith("_lock")
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    )
+
+
+@register
+class StateLockDiscipline(Rule):
+    id = "LOCK-001"
+    summary = "ServerState map mutations and WAL appends only under self._lock"
+    rationale = (
+        "one asyncio.Lock guards all five registries by design; a "
+        "mutation outside it reorders against concurrent handlers and "
+        "desyncs the WAL from in-memory application order"
+    )
+
+    def check(self, module: Module) -> list[Finding]:
+        out: list[Finding] = []
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.ClassDef) and node.name == "ServerState":
+                for item in node.body:
+                    if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                        if item.name == "__init__":
+                            continue
+                        self._check_method(module, item, out)
+        return out
+
+    def _check_method(
+        self, module: Module,
+        func: ast.FunctionDef | ast.AsyncFunctionDef,
+        out: list[Finding],
+    ) -> None:
+        aliases: set[str] = set()  # locals aliasing a protected container
+
+        def note_alias(stmt: ast.stmt) -> None:
+            if not isinstance(stmt, ast.Assign) or len(stmt.targets) != 1:
+                return
+            target = stmt.targets[0]
+            if not isinstance(target, ast.Name):
+                return
+            value = stmt.value
+            # per_user = self._user_sessions  (whole-map alias)
+            if _is_self_attr(value, PROTECTED_ATTRS):
+                aliases.add(target.id)
+            # per_user = self._user_sessions.setdefault/get(...)  (member list)
+            elif (
+                isinstance(value, ast.Call)
+                and isinstance(value.func, ast.Attribute)
+                and value.func.attr in ("get", "setdefault")
+                and _is_self_attr(value.func.value, CONTAINER_MAPS)
+            ):
+                aliases.add(target.id)
+
+        def is_protected(expr: ast.expr) -> bool:
+            if _is_self_attr(expr, PROTECTED_ATTRS):
+                return True
+            return isinstance(expr, ast.Name) and expr.id in aliases
+
+        def mutation_of(stmt_or_expr: ast.AST) -> str | None:
+            """A human-readable description when the node mutates
+            protected state, else None."""
+            node = stmt_or_expr
+            if isinstance(node, (ast.Assign, ast.AugAssign)):
+                targets = (
+                    node.targets if isinstance(node, ast.Assign) else [node.target]
+                )
+                for t in targets:
+                    if _is_self_attr(t, PROTECTED_ATTRS):
+                        return f"rebinds self.{t.attr}"
+                    if isinstance(t, ast.Subscript) and is_protected(t.value):
+                        return "subscript-assigns into a protected map"
+            if isinstance(node, ast.Delete):
+                for t in node.targets:
+                    if isinstance(t, ast.Subscript) and is_protected(t.value):
+                        return "deletes from a protected map"
+            if isinstance(node, ast.Call):
+                f = node.func
+                if isinstance(f, ast.Attribute):
+                    if f.attr in MUTATORS and is_protected(f.value):
+                        return f"calls .{f.attr}() on a protected container"
+                    if (
+                        f.attr in JOURNAL_CALLS
+                        and isinstance(f.value, ast.Name)
+                        and f.value.id == "self"
+                    ):
+                        return "appends to the journal"
+                    if (
+                        f.attr == "append"
+                        and _is_self_attr(f.value, frozenset({"journal"}))
+                    ):
+                        return "appends to the journal"
+            return None
+
+        def own_exprs(stmt: ast.stmt) -> list[ast.expr]:
+            """Expression trees attached directly to this statement —
+            expressions cannot contain statements, so scanning them never
+            leaks into a nested (possibly locked) block."""
+            if isinstance(stmt, ast.Expr):
+                return [stmt.value]
+            if isinstance(stmt, (ast.Assign, ast.AugAssign)):
+                return [stmt.value]
+            if isinstance(stmt, ast.Return) and stmt.value is not None:
+                return [stmt.value]
+            if isinstance(stmt, (ast.If, ast.While)):
+                return [stmt.test]
+            if isinstance(stmt, (ast.For, ast.AsyncFor)):
+                return [stmt.iter]
+            if isinstance(stmt, ast.Raise) and stmt.exc is not None:
+                return [stmt.exc]
+            return []
+
+        def walk(stmts: list[ast.stmt], locked: bool) -> None:
+            for stmt in stmts:
+                note_alias(stmt)
+                inner_locked = locked
+                if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                    if any(_is_lock_expr(i.context_expr) for i in stmt.items):
+                        inner_locked = True
+                    walk(stmt.body, inner_locked)
+                    continue
+                if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    continue  # nested helpers are checked where they run
+                if not locked:
+                    desc = mutation_of(stmt)
+                    if desc is None:
+                        for expr in own_exprs(stmt):
+                            for sub in ast.walk(expr):
+                                if isinstance(sub, ast.Call):
+                                    desc = mutation_of(sub)
+                                    if desc is not None:
+                                        break
+                            if desc is not None:
+                                break
+                    if desc is not None:
+                        out.append(self.finding(
+                            module, stmt,
+                            f"{func.name} {desc} outside `with self._lock` — "
+                            "take the state lock (or waive with the "
+                            "documented reason if provably single-threaded)",
+                        ))
+                        continue
+                # recurse into compound statements, preserving lock state
+                for attr in ("body", "orelse", "finalbody"):
+                    sub = getattr(stmt, attr, None)
+                    if sub:
+                        walk(sub, locked)
+                for handler in getattr(stmt, "handlers", []) or []:
+                    walk(handler.body, locked)
+
+        walk(func.body, locked=False)

@@ -93,7 +93,7 @@ async def do_login(client: AuthClient, user: str, password: str) -> str:
 async def do_batch_register(client: AuthClient, users: list[str], passwords: list[str]) -> str:
     """client.rs:287-340."""
     y1s, y2s = [], []
-    for user, password in zip(users, passwords):
+    for user, password in zip(users, passwords, strict=True):
         prover = Prover(Parameters.new(), Witness(password_to_scalar(password, user)))
         y1s.append(Ristretto255.element_to_bytes(prover.statement.y1))
         y2s.append(Ristretto255.element_to_bytes(prover.statement.y2))
@@ -115,7 +115,7 @@ async def do_batch_login(client: AuthClient, users: list[str], passwords: list[s
     rng = SecureRng()
     ids, cids, proofs = [], [], []
     errors = {}
-    for user, password in zip(users, passwords):
+    for user, password in zip(users, passwords, strict=True):
         try:
             ch = await client.create_challenge(user)
         except grpc.aio.AioRpcError as e:

@@ -81,9 +81,14 @@ async def recover_state(state, snapshot_path: str, wal_path: str) -> RecoveryRep
     #    replaying the log from seq 0.
     records: list[dict] = []
     if os.path.exists(wal_path):
-        try:
+        def _read_log() -> bytes:
             with open(wal_path, "rb") as f:
-                raw = f.read()
+                return f.read()
+
+        try:
+            # worker thread: the log can be compact_bytes-sized, and boot
+            # may run with the health listener already up
+            raw = await asyncio.to_thread(_read_log)
         except OSError as e:
             report.wal_quarantined = quarantine_file(wal_path, int(time.time()))
             log.error(

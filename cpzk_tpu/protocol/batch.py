@@ -496,7 +496,7 @@ class BatchVerifier:
             [Ristretto255.element_to_bytes(e.proof.commitment.r2) for e in self.entries],
         )
         rows = []
-        for entry, c in zip(self.entries, challenges):
+        for entry, c in zip(self.entries, challenges, strict=True):
             rows.append(
                 BatchRow(
                     g=entry.params.generator_g,

@@ -82,6 +82,13 @@ class Witness:
     def clear(self) -> None:
         self._x = Scalar(0)
 
+    def __repr__(self) -> str:
+        # redaction guard: a Witness in a log line / traceback / debugger
+        # must never emit the scalar encoding (docs/security.md LEAK-001)
+        return "Witness(<secret scalar redacted>)"
+
+    __str__ = __repr__
+
 
 @dataclass(frozen=True)
 class Statement:
@@ -125,6 +132,13 @@ class Response:
 
     def clear(self) -> None:
         self._s = Scalar(0)
+
+    def __repr__(self) -> str:
+        # redaction guard: the response scalar is bound to the witness;
+        # reprs must never emit its encoding (docs/security.md LEAK-001)
+        return "Response(<secret scalar redacted>)"
+
+    __str__ = __repr__
 
 
 class Proof:

@@ -27,6 +27,13 @@ class Nonce:
     def clear(self) -> None:
         self._k = Scalar(0)
 
+    def __repr__(self) -> str:
+        # redaction guard: leaking k leaks the witness (s = k + c*x), so
+        # reprs must never emit its encoding (docs/security.md LEAK-001)
+        return "Nonce(<secret scalar redacted>)"
+
+    __str__ = __repr__
+
 
 class Prover:
     """Generates proofs of knowledge of x with y1 = g^x, y2 = h^x."""

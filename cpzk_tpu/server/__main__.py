@@ -369,11 +369,13 @@ async def amain(args) -> None:
 
     tls = None
     if config.tls.enabled:
-        with open(config.tls.key_path, "rb") as f:
-            key = f.read()
-        with open(config.tls.cert_path, "rb") as f:
-            cert = f.read()
-        tls = (key, cert)
+        def _read_tls(key_path: str, cert_path: str) -> tuple[bytes, bytes]:
+            with open(key_path, "rb") as kf, open(cert_path, "rb") as cf:
+                return kf.read(), cf.read()
+
+        tls = await asyncio.to_thread(
+            _read_tls, config.tls.key_path, config.tls.cert_path
+        )
 
     from .service import serve
 

@@ -179,7 +179,7 @@ class DynamicBatcher:
             )
         loop = asyncio.get_running_loop()
         futs = [loop.create_future() for _ in entries]
-        self._queue.extend(zip(entries, futs))
+        self._queue.extend(zip(entries, futs, strict=True))
         self._set_depth_gauge()
         self._wakeup.set()
         # Futures resolve to an Error VALUE for a per-entry verification
@@ -404,7 +404,7 @@ class DynamicBatcher:
                     fut.set_exception(exc)
             return
         metrics.histogram("tpu.batch.latency").observe(time.perf_counter() - t0)
-        for fut, res in zip(futs, results):
+        for fut, res in zip(futs, results, strict=True):
             if not fut.done():
                 fut.set_result(res)
 

@@ -390,7 +390,7 @@ class AuthServiceImpl:
         users = await self.state.get_users(
             [user_ids[i] for i in staged])
         live: list[tuple[int, UserData]] = []
-        for i, challenge, user in zip(staged, challenges, users):
+        for i, challenge, user in zip(staged, challenges, users, strict=True):
             if (
                 challenge is None
                 or challenge.user_id != user_ids[i]
@@ -410,7 +410,7 @@ class AuthServiceImpl:
             defer_point_validation=self.batcher is None,
         )
         params = Parameters.new()  # shared generators: one instance per RPC
-        for (i, user), proof in zip(live, parsed):
+        for (i, user), proof in zip(live, parsed, strict=True):
             if isinstance(proof, errors.Error):
                 error_msgs[i] = f"Invalid proof: {proof}"
                 continue
@@ -475,7 +475,7 @@ class AuthServiceImpl:
             tokens[i] = token_pool[64 * k: 64 * (k + 1)]
         session_errs = await self.state.create_sessions(
             [(tokens[i], contexts[i]) for i in verified])
-        session_err_by_index = dict(zip(verified, session_errs))
+        session_err_by_index = dict(zip(verified, session_errs, strict=True))
 
         results = []
         n_failure = 0

@@ -139,6 +139,7 @@ class ServerState:
         once by ``DurabilityManager.recover`` before serving starts)."""
         self.journal = wal
 
+    # cpzk-lint: disable=LOCK-001 -- append funnel: every caller holds self._lock (docstring contract)
     def _journal_append(self, rtype: str, payload: dict) -> None:
         """Append one record — callers hold ``self._lock``, which pins WAL
         order to in-memory application order."""
@@ -154,6 +155,7 @@ class ServerState:
         if wal is not None and wal.needs_sync():
             await asyncio.to_thread(wal.sync)
 
+    # cpzk-lint: disable=LOCK-001 -- boot-time replay runs single-threaded before serving starts
     def replay_journal_record(self, rec: dict) -> str | None:
         """Boot-time replay of one WAL record through the same
         trust-boundary validators as :meth:`restore` — a tampered log
@@ -540,12 +542,17 @@ class ServerState:
         canonical decoder, every capacity cap is enforced, sessions must
         reference registered users and carry sane expiries — a corrupt or
         tampered file fails loudly rather than registering garbage."""
+        import asyncio as _asyncio
         import json
 
         from ..core.ristretto import Ristretto255
 
-        with open(path, encoding="utf-8") as f:
-            doc = json.load(f)
+        def _read() -> dict:
+            with open(path, encoding="utf-8") as f:
+                return json.load(f)
+
+        # worker thread: a multi-MB snapshot read must not stall the loop
+        doc = await _asyncio.to_thread(_read)
         if doc.get("version") != self.SNAPSHOT_VERSION:
             raise InvalidParams(
                 f"Unsupported state snapshot version: {doc.get('version')!r}"

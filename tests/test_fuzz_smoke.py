@@ -38,6 +38,7 @@ def _load(name: str):
         ("fuzz_statement_validation", 400),
         ("fuzz_wal_replay", 300),
         ("fuzz_admission", 400),
+        ("fuzz_lint", 150),
     ],
 )
 def test_fuzz_target_smoke(target, runs):
