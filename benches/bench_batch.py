@@ -27,13 +27,16 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 SIZES = (1, 2, 5, 10, 20, 50, 100)
 
 
-def best_of(fn, runs: int = 3) -> float:
-    best = float("inf")
+def best_of(fn, runs: int = 3) -> tuple[float, float]:
+    """(best seconds, spread) over ``runs`` calls; spread = max-min is the
+    run's own noise bound, carried into PerfSnapshot entries so the
+    regression gate widens itself on noisy machines."""
+    times = []
     for _ in range(runs):
         t0 = time.perf_counter()
         fn()
-        best = min(best, time.perf_counter() - t0)
-    return best
+        times.append(time.perf_counter() - t0)
+    return min(times), max(times) - min(times)
 
 
 def main() -> None:
@@ -42,6 +45,12 @@ def main() -> None:
     ap.add_argument("--platform", default=None,
                     help="force a JAX platform (e.g. cpu) for --tpu smoke runs")
     ap.add_argument("--sizes", default=",".join(map(str, SIZES)))
+    ap.add_argument("--runs", type=int, default=3,
+                    help="timed repetitions per config (best-of)")
+    ap.add_argument("--snapshot", default=None,
+                    help="also write a cpzk-perf-snapshot JSON here (the "
+                         "CI regression gate's input — see "
+                         "cpzk_tpu.observability.regress)")
     args = ap.parse_args()
     sizes = [int(s) for s in args.sizes.split(",")]
 
@@ -86,7 +95,7 @@ def main() -> None:
                 t.append_context(ctx)
                 Verifier(params, st).verify_with_transcript(pr, t)
 
-        results.append(("individual", "host", n, best_of(individual)))
+        results.append(("individual", "host", n, *best_of(individual, args.runs)))
 
         for bname, backend in backends:
             def batched(n=n, backend=backend):
@@ -97,7 +106,7 @@ def main() -> None:
 
             if bname == "tpu":
                 batched()  # warm the jit cache outside the timed region
-            results.append(("batch_e2e", bname, n, best_of(batched)))
+            results.append(("batch_e2e", bname, n, *best_of(batched, args.runs)))
 
         # mixed validity: one mismatched row forces the fallback pass
         if n >= 2:
@@ -109,7 +118,9 @@ def main() -> None:
                 res = bv.verify(rng)
                 assert res[-1] is not None
 
-            results.append(("batch_mixed_validity", "cpu", n, best_of(mixed)))
+            results.append(
+                ("batch_mixed_validity", "cpu", n, *best_of(mixed, args.runs))
+            )
 
     # add() cost (validation on add), reference batch_verification.rs:152-172
     def add_cost():
@@ -117,9 +128,11 @@ def main() -> None:
         for st, pr, ctx in rows[: min(100, nmax)]:
             bv.add_with_context(params, st, pr, ctx)
 
-    results.append(("batch_add", "host", min(100, nmax), best_of(add_cost)))
+    results.append(
+        ("batch_add", "host", min(100, nmax), *best_of(add_cost, args.runs))
+    )
 
-    for name, backend, n, secs in results:
+    for name, backend, n, secs, spread in results:
         print(
             json.dumps(
                 {
@@ -128,10 +141,28 @@ def main() -> None:
                     "n": n,
                     "value": round(secs * 1e3, 3),
                     "unit": "ms/batch",
+                    "spread_ms": round(spread * 1e3, 3),
                     "per_proof_us": round(secs / n * 1e6, 1),
                 }
             )
         )
+
+    if args.snapshot:
+        from cpzk_tpu.observability.perf import PerfEntry, write_snapshot
+
+        entries = [
+            PerfEntry(
+                name=name, backend=backend, n=n,
+                value=round(secs * 1e3, 4), unit="ms/batch",
+                spread=round(spread * 1e3, 4),
+            )
+            for name, backend, n, secs, spread in results
+        ]
+        write_snapshot(
+            args.snapshot, entries,
+            meta={"bench": "bench_batch", "runs": args.runs},
+        )
+        print(f"# perf snapshot written to {args.snapshot}", file=sys.stderr)
 
 
 if __name__ == "__main__":

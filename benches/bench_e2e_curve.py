@@ -211,6 +211,10 @@ def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--ns", default=os.environ.get("CPZK_E2E_NS", ""))
     ap.add_argument("--backend", default="cpu", choices=["cpu", "tpu"])
+    ap.add_argument("--snapshot", default=None,
+                    help="also write a cpzk-perf-snapshot JSON here "
+                         "(throughput per n + flight-recorder stage "
+                         "percentiles when the batcher path ran)")
     args = ap.parse_args()
 
     plat = os.environ.get("CPZK_BENCH_PLATFORM")
@@ -244,7 +248,13 @@ def main() -> None:
     platform = jax.devices()[0].platform if args.backend == "tpu" else "host"
 
     rng, params, provers = build_corpus()
+    snapshot_entries = []
     for n in ns:
+        from cpzk_tpu.observability import get_flight_recorder
+        from cpzk_tpu.observability.perf import PerfEntry, stage_percentiles
+
+        recorder = get_flight_recorder()
+        recorder.clear()  # stage percentiles attribute to this n only
         direct = direct_curve_point(n, provers, rng, params, args.backend)
         grpc_pps, grpc_pipelined = asyncio.run(
             grpc_curve_point(n, provers, rng, args.backend))
@@ -258,6 +268,26 @@ def main() -> None:
             "backend": args.backend,
             "unit": "proofs/s",
         }), flush=True)
+        stages = stage_percentiles(recorder.snapshot())
+        for name, pps in (
+            ("e2e_curve.grpc", grpc_pps),
+            ("e2e_curve.grpc_pipelined", grpc_pipelined),
+            ("e2e_curve.direct", direct),
+        ):
+            snapshot_entries.append(PerfEntry(
+                name=name, backend=args.backend, n=n,
+                value=round(pps, 2), unit="proofs/s",
+                stages_ms=stages if name.startswith("e2e_curve.grpc") else {},
+            ))
+
+    if args.snapshot:
+        from cpzk_tpu.observability.perf import write_snapshot
+
+        write_snapshot(
+            args.snapshot, snapshot_entries,
+            meta={"bench": "bench_e2e_curve", "platform": platform},
+        )
+        print(f"# perf snapshot written to {args.snapshot}", file=sys.stderr)
 
 
 if __name__ == "__main__":

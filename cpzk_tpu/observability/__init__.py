@@ -22,6 +22,12 @@ the process-wide tracer, metric buckets, and log format in one call.
 from __future__ import annotations
 
 from .context import RequestContext, current_context, new_trace_id
+from .flightrec import (
+    FlightRecord,
+    FlightRecorder,
+    format_flightrec,
+    get_flight_recorder,
+)
 from .instrument import rpc_deadline, traced_rpc
 from .logs import JsonLogFormatter, enable_json_logs
 from .tracing import (
@@ -36,6 +42,8 @@ from .tracing import (
 
 __all__ = [
     "BatchStages",
+    "FlightRecord",
+    "FlightRecorder",
     "JsonLogFormatter",
     "RequestContext",
     "SpanRecord",
@@ -44,8 +52,10 @@ __all__ = [
     "configure",
     "current_context",
     "enable_json_logs",
+    "format_flightrec",
     "format_trace",
     "format_tracez",
+    "get_flight_recorder",
     "get_tracer",
     "new_trace_id",
     "rpc_deadline",
@@ -55,8 +65,9 @@ __all__ = [
 
 def configure(settings) -> None:
     """Apply an ``ObservabilitySettings`` (see ``server/config.py``):
-    trace ring capacity, slow-request threshold, histogram buckets, and
-    the JSON log formatter opt-in."""
+    trace ring capacity, slow-request threshold, histogram buckets, the
+    flight-recorder ring + compile-storm window, and the JSON log
+    formatter opt-in."""
     from ..server import metrics
 
     get_tracer().configure(
@@ -65,6 +76,10 @@ def configure(settings) -> None:
             -1.0 if settings.slow_request_ms < 0
             else settings.slow_request_ms / 1000.0
         ),
+    )
+    get_flight_recorder().configure(
+        capacity=settings.flight_ring,
+        storm_threshold=settings.compile_storm_threshold,
     )
     buckets = settings.parsed_buckets()
     if buckets:

@@ -32,11 +32,21 @@ from contextlib import contextmanager
 from dataclasses import dataclass, field
 
 from ..server import metrics
+from . import flightrec
 from .context import RequestContext, new_trace_id
+from .flightrec import (
+    STAGE_COMPILE,
+    STAGE_EXECUTE,
+    STAGE_MARSHAL,
+    STAGE_THREAD_HOP,
+    FlightRecord,
+)
 
 #: Canonical pipeline stage names (doc + test vocabulary).  ``queue_wait``
 #: and ``device_dispatch`` bracket the device; ``pad_and_pack`` /
-#: ``unpack`` are the host stages around it.
+#: ``unpack`` are the host stages around it.  The flight recorder widens
+#: ``device_dispatch`` into ``thread_hop``/``marshal``/``compile``/
+#: ``execute`` sub-spans (see :mod:`.flightrec`).
 STAGE_QUEUE_WAIT = "queue_wait"
 STAGE_PAD_AND_PACK = "pad_and_pack"
 STAGE_DEVICE_DISPATCH = "device_dispatch"
@@ -230,7 +240,17 @@ class BatchStages:
     """Stage recorder handed to ``BatchVerifier.verify``: each stage is
     timed once per device batch and fanned out as a span to every member
     trace, observed into the stage latency histograms, and wrapped in a
-    matching ``TraceAnnotation`` so xprof shows the same stage names."""
+    matching ``TraceAnnotation`` so xprof shows the same stage names.
+
+    Flight-recorder integration: the batcher calls :meth:`mark_submit`
+    just before handing the batch to a worker thread and
+    :meth:`mark_worker_start` as the worker picks it up (the
+    ``thread_hop`` span); the ``device_dispatch`` stage installs a
+    :class:`~cpzk_tpu.observability.flightrec.DeviceSink` the backend
+    reports marshal time and jit cache outcomes into, which this class
+    turns into ``marshal``/``compile``/``execute`` sub-spans; and
+    :meth:`finalize` folds everything into one
+    :class:`~cpzk_tpu.observability.flightrec.FlightRecord`."""
 
     def __init__(
         self,
@@ -238,18 +258,69 @@ class BatchStages:
         trace_ids: list[str],
         batch_size: int = 0,
         backend_label: str = "cpu",
+        queue_wait_s: float = 0.0,
     ):
         self.tracer = tracer
         self.trace_ids = [t for t in trace_ids if t]
         self.batch_size = batch_size
         self.backend_label = backend_label
+        self.queue_wait_s = queue_wait_s
+        #: accumulated seconds per stage name (incl. the widened vocab)
+        self.durations: dict[str, float] = {}
+        self._submitted_at: float | None = None
+        self._worker_ended_at: float | None = None
+        self._sink: flightrec.DeviceSink | None = None
+        self._gap_s = 0.0
+
+    # -- flight-recorder marks ---------------------------------------------
+
+    def mark_submit(self) -> None:
+        """Stamp the dispatch commit (event-loop side, just before the
+        batch crosses to a worker thread)."""
+        self._submitted_at = time.monotonic()
+
+    def mark_worker_start(self) -> None:
+        """Stamp worker-thread pickup; the elapsed time since
+        :meth:`mark_submit` is the ``thread_hop`` span — the per-batch
+        cost of the ``asyncio.to_thread`` seam."""
+        if self._submitted_at is None:
+            return
+        now = time.monotonic()
+        dur = max(0.0, now - self._submitted_at)
+        self._emit(STAGE_THREAD_HOP, now - dur, dur)
+        metrics.histogram("tpu.batch.thread_hop").observe(dur)
+
+    def mark_worker_end(self) -> None:
+        """Stamp verify completion on the worker thread; the record's
+        ``wall_s`` is submit -> here, the interval the widened stages
+        tile (the hop back to the event loop is scheduling latency the
+        RPC trace already covers, not device-plane work)."""
+        self._worker_ended_at = time.monotonic()
+
+    def _emit(self, name: str, start: float, dur: float, **attrs) -> None:
+        self.durations[name] = self.durations.get(name, 0.0) + dur
+        if self.tracer is not None:
+            for tid in self.trace_ids:
+                self.tracer.add_span(
+                    tid, name, start, dur,
+                    batch=self.batch_size, backend=self.backend_label,
+                    **attrs,
+                )
 
     @contextmanager
     def stage(self, name: str):
+        device = name == STAGE_DEVICE_DISPATCH
+        token = None
+        if device:
+            self._sink, token = flightrec.install_sink()
         t0 = time.monotonic()
-        with _trace_annotation(name):
-            yield
-        dur = time.monotonic() - t0
+        try:
+            with _trace_annotation(name):
+                yield
+        finally:
+            dur = time.monotonic() - t0
+            if device:
+                flightrec.uninstall_sink(token)
         hist = _STAGE_HISTOGRAM.get(name)
         if hist == "tpu.batch.device_time":
             metrics.histogram(hist, labelnames=("backend",)).labels(
@@ -257,12 +328,66 @@ class BatchStages:
             ).observe(dur)
         elif hist is not None:
             metrics.histogram(hist).observe(dur)
-        if self.tracer is not None:
-            for tid in self.trace_ids:
-                self.tracer.add_span(
-                    tid, name, t0, dur,
-                    batch=self.batch_size, backend=self.backend_label,
-                )
+        self._emit(name, t0, dur)
+        if device:
+            self._split_device(t0, dur)
+
+    def _split_device(self, t0: float, dur: float) -> None:
+        """Widen the ``device_dispatch`` interval into ``marshal`` /
+        ``compile`` / ``execute`` from the sink the backend reported
+        into.  Attribution rule: marshal is measured directly; when any
+        program in the batch was a first-sight compile, the non-marshal
+        remainder is ``compile`` (a first call at a new padded shape is
+        trace+compile dominated), otherwise it is ``execute``.  A
+        backend that reports nothing (the CPU oracle) is pure
+        ``execute``."""
+        sink = self._sink or flightrec.DeviceSink()
+        marshal = min(max(0.0, sink.marshal_s), dur)
+        rest = max(0.0, dur - marshal)
+        compile_s, execute_s = (
+            (rest, 0.0) if sink.jit_misses > 0 else (0.0, rest)
+        )
+        if marshal > 0.0:
+            self._emit(STAGE_MARSHAL, t0, marshal)
+        if compile_s > 0.0:
+            self._emit(
+                STAGE_COMPILE, t0 + marshal, compile_s,
+                shapes=",".join(sink.compiled),
+            )
+            metrics.histogram("tpu.jit.compile_time").observe(compile_s)
+        self._emit(STAGE_EXECUTE, t0 + marshal + compile_s, execute_s)
+        self._gap_s = flightrec.get_flight_recorder().note_device_interval(
+            t0, t0 + dur
+        )
+
+    def finalize(self, wall_s: float) -> "flightrec.FlightRecord":
+        """Fold the recorded stages into one flight record (called by the
+        batcher once the dispatch's results are in).  ``wall_s`` is the
+        event-loop submit->resolved wall time, used as a fallback; when
+        the worker marks ran, the record's wall is submit->verify-end —
+        the interval the widened stages tile, which is what the stage-sum
+        invariant is pinned against."""
+        if self._submitted_at is not None and self._worker_ended_at is not None:
+            wall_s = max(0.0, self._worker_ended_at - self._submitted_at)
+        sink = self._sink or flightrec.DeviceSink()
+        lanes = sink.lanes
+        rows = sink.rows or self.batch_size
+        occupancy = (rows / lanes) if lanes > 0 else 1.0
+        rec = FlightRecord(
+            batch=self.batch_size,
+            lanes=lanes,
+            occupancy=occupancy,
+            pad_waste=max(0.0, 1.0 - occupancy),
+            backend=self.backend_label,
+            queue_wait_s=self.queue_wait_s,
+            stages_s=dict(self.durations),
+            wall_s=wall_s,
+            dispatch_gap_s=self._gap_s,
+            jit_hits=sink.jit_hits,
+            jit_misses=sink.jit_misses,
+            compiled=list(sink.compiled),
+        )
+        return flightrec.get_flight_recorder().record(rec)
 
 
 # -- operator rendering -------------------------------------------------------

@@ -25,7 +25,9 @@ matches the reference bit-for-bit (SURVEY.md §3.2).
 from __future__ import annotations
 
 import os
+import threading
 import time
+from collections import OrderedDict
 from functools import partial
 
 import numpy as np
@@ -83,10 +85,34 @@ if LANE_CHUNK % min(LANE_QUANTUM, LANE_CHUNK):
     LANE_CHUNK = _rounded
 
 
+#: LRU bound on ``TpuBackend._gh_cache`` — device-resident generator-pair
+#: points keyed by statement bytes.  Real deployments share one generator
+#: pair, so 128 is generous; the bound exists because an adversarial (or
+#: merely huge) registered-statement population must not leak device/host
+#: memory one [20, 1] coordinate set at a time.
+GH_CACHE_MAX = int(os.environ.get("CPZK_GH_CACHE_MAX", "128"))
+
+
+def _note_gh_cache(size: int, evicted: int) -> None:
+    """Generator-pair cache telemetry (``tpu.gh_cache.size`` gauge,
+    ``tpu.gh_cache.evictions`` counter); optional like all server-layer
+    metrics from this module."""
+    try:
+        from ..server import metrics
+
+        metrics.gauge("tpu.gh_cache.size").set(size)
+        if evicted:
+            metrics.counter("tpu.gh_cache.evictions").inc(evicted)
+    except Exception:  # pragma: no cover - server layer unavailable
+        pass
+
+
 def _note_pad_waste(n: int, pad: int) -> None:
     """Batch-shape telemetry: fraction of device lanes burned on padding
-    for the most recent batch (``tpu.batch.pad_waste`` gauge).  Metrics
-    live in the server layer; this module stays importable without it."""
+    for the most recent batch (``tpu.batch.pad_waste`` gauge) plus the
+    flight recorder's occupancy accounting (``tpu.batch.occupancy``).
+    Metrics live in the server layer; this module stays importable
+    without it."""
     try:
         from ..server import metrics
 
@@ -95,6 +121,48 @@ def _note_pad_waste(n: int, pad: int) -> None:
         )
     except Exception:  # pragma: no cover - server layer unavailable
         pass
+    try:
+        from ..observability import flightrec
+
+        flightrec.note_lanes(n, pad)
+    except Exception:  # pragma: no cover - observability unavailable
+        pass
+
+
+def _note_marshal(t0: float) -> None:
+    """Report elapsed host limb-marshal seconds since ``t0`` into the
+    flight recorder's device sink (no-op outside an instrumented batch)."""
+    try:
+        from ..observability import flightrec
+
+        flightrec.note_marshal(time.perf_counter() - t0)
+    except Exception:  # pragma: no cover - observability unavailable
+        pass
+
+
+#: First-sight registry of jitted device programs, keyed by (kernel name,
+#: static args, padded shape) — the cache key the flight recorder uses to
+#: attribute a dispatch's cost to ``compile`` (first sight of a padded
+#: shape pays an XLA trace+compile) vs ``execute``.  Guarded: pipelined
+#: batches call the backend from multiple worker threads.
+_JIT_SEEN: set[tuple] = set()
+_JIT_LOCK = threading.Lock()
+
+
+def _jit_first_sight(*key) -> bool:
+    """Register one jitted-program dispatch; True when this process has
+    never dispatched this (kernel, shape) before."""
+    with _JIT_LOCK:
+        first = key not in _JIT_SEEN
+        if first:
+            _JIT_SEEN.add(key)
+    try:
+        from ..observability import flightrec
+
+        flightrec.note_jit("/".join(str(k) for k in key), first)
+    except Exception:  # pragma: no cover - observability unavailable
+        pass
+    return first
 
 
 def _pad_pow2(n: int) -> int:
@@ -304,15 +372,18 @@ def chunked_combined_identity(pad, r1, y1, r2, y2,
     chunk schedule — TpuBackend serves it and bench.py times it, so the
     bench cannot drift from the shipped dispatch."""
     if pad <= LANE_CHUNK:
+        _jit_first_sight("combined", pad)
         return bool(_combined(pad, r1, y1, r2, y2, w_a, w_ac, w_ba, w_bac))
     parts = []
     for lo, hi in _chunk_bounds(pad):
+        _jit_first_sight("combined_partial", hi - lo)
         parts.append(_combined_partial(
             hi - lo,
             _chunk_point(r1, lo, hi), _chunk_point(y1, lo, hi),
             _chunk_point(r2, lo, hi), _chunk_point(y2, lo, hi),
             w_a[:, lo:hi], w_ac[:, lo:hi],
             w_ba[:, lo:hi], w_bac[:, lo:hi]))
+    _jit_first_sight("partials", len(parts))
     return bool(_partials_are_identity(_stack_partials(parts)))
 
 
@@ -323,11 +394,14 @@ def chunked_msm_identity(c: int, pts: curve.Point,
     for the same no-drift reason as :func:`chunked_combined_identity`."""
     m_pad = digits.shape[-1]
     if m_pad <= LANE_CHUNK:
+        _jit_first_sight("msm", c, m_pad)
         return bool(_msm_identity(c, pts, digits))
     parts = []
     for lo, hi in _chunk_bounds(m_pad):
+        _jit_first_sight("msm_partial", c, hi - lo)
         parts.append(_msm_partial(
             c, _chunk_point(pts, lo, hi), digits[:, lo:hi]))
+    _jit_first_sight("partials", len(parts))
     return bool(_partials_are_identity(_stack_partials(parts)))
 
 
@@ -344,18 +418,28 @@ class TpuBackend(VerifierBackend):
     prefers_combined = True
 
     def __init__(self, mesh_devices: int | None = None,
-                 pippenger_min: int | None = None):
+                 pippenger_min: int | None = None,
+                 gh_cache_max: int | None = None):
         """``pippenger_min`` overrides the rowcombined->Pippenger crossover
         for this instance (None = the module default / CPZK_PIPPENGER_MIN);
         a constructor parameter so callers (drivers, calibration sweeps)
-        never need the env-plus-module-reload dance."""
-        import threading
-
+        never need the env-plus-module-reload dance.  ``gh_cache_max``
+        bounds the per-generator-pair device-point cache (None = the
+        GH_CACHE_MAX module default / CPZK_GH_CACHE_MAX)."""
         self._pippenger_min = (
             PIPPENGER_MIN_ROWS if pippenger_min is None else pippenger_min
         )
 
-        self._gh_cache: dict[tuple[bytes, bytes], tuple[curve.Point, curve.Point]] = {}
+        # LRU-bounded generator-pair cache: keyed by statement generator
+        # bytes, so millions of distinct registered statements must not
+        # grow it without bound (the KeyedTokenBuckets containment story
+        # applied to device memory) — least-recently-verified pair evicts
+        self._gh_cache: OrderedDict[
+            tuple[bytes, bytes], tuple[curve.Point, curve.Point]
+        ] = OrderedDict()
+        self._gh_cache_max = max(
+            1, GH_CACHE_MAX if gh_cache_max is None else gh_cache_max
+        )
         # the pipelined batcher calls verify_* from multiple worker
         # threads; guard the check-then-insert so a cold generator pair
         # is marshalled once, not once per concurrent batch
@@ -382,15 +466,23 @@ class TpuBackend(VerifierBackend):
             Ristretto255.element_to_bytes(row.g),
             Ristretto255.element_to_bytes(row.h),
         )
+        evicted = 0
         with self._gh_lock:
-            if key not in self._gh_cache:
+            pair = self._gh_cache.pop(key, None)
+            if pair is None:
                 # single shared points keep a size-1 batch axis ([20, 1]
                 # coords) and broadcast against the [20, n] row arrays
-                self._gh_cache[key] = (
+                pair = (
                     curve.points_to_device([row.g.point]),
                     curve.points_to_device([row.h.point]),
                 )
-            return self._gh_cache[key]
+            self._gh_cache[key] = pair  # (re)insert most-recently-used
+            while len(self._gh_cache) > self._gh_cache_max:
+                self._gh_cache.popitem(last=False)
+                evicted += 1
+            size = len(self._gh_cache)
+        _note_gh_cache(size, evicted)
+        return pair
 
     # -- VerifierBackend interface ------------------------------------------
 
@@ -406,7 +498,7 @@ class TpuBackend(VerifierBackend):
         # correction row: G in slot r1 with -sum(a s), H in slot y1 with
         # -b sum(a s); identity in the other two slots.
         debug = os.environ.get("CPZK_BATCH_DEBUG") == "1"
-        t0 = time.perf_counter() if debug else 0.0
+        t0 = time.perf_counter()
         pad = _pad_lanes(n + 1)
         _note_pad_waste(n + 1, pad)
         r1 = _elems_soa([r.r1 for r in rows] + [rows[0].g], pad)
@@ -414,6 +506,7 @@ class TpuBackend(VerifierBackend):
         r2 = _elems_soa([r.r2 for r in rows], pad)
         y2 = _elems_soa([r.y2 for r in rows], pad)
         if device_rlc:
+            _jit_first_sight("rlc", pad)
             w_a, w_ac, w_ba, w_bac = _rlc_windows_device(rows, beta, pad)
         else:
             b = beta.value
@@ -428,6 +521,7 @@ class TpuBackend(VerifierBackend):
             w_ac = _windows(ac + [(L - b * sum_as % L) % L], pad)
             w_ba = _windows(ba, pad)
             w_bac = _windows(bac, pad)
+        _note_marshal(t0)
 
         if not debug:
             return chunked_combined_identity(
@@ -455,6 +549,7 @@ class TpuBackend(VerifierBackend):
         come from the device scalar plane (``_pippenger_digits_device``)
         instead of per-row host big-int products.
         """
+        t0 = time.perf_counter()
         elems = (
             [r.r1 for r in rows]
             + [r.y1 for r in rows]
@@ -463,7 +558,13 @@ class TpuBackend(VerifierBackend):
             + [rows[0].g, rows[0].h]
         )
         m = 4 * _pad_pow2(len(rows)) + 2
-        c = msm.pick_window(m)
+        # window size is per-PROGRAM: past the chunk cap the MSM runs as
+        # LANE_CHUNK-term tiles (chunked_msm_identity) and each device of
+        # a mesh sees at most LANE_CHUNK lanes (_mesh_step), so the cost
+        # model must see the chunk length, not the full term count —
+        # sizing from m overshot c by 2 windows at 64k terms (ADVICE.md /
+        # ROADMAP item 4 calibration-tail fix)
+        c = msm.pick_window(min(m, LANE_CHUNK))
         # m is already shape-quantized (4*pow2+2), so below the chunk cap
         # it is used EXACTLY; above it, quantum padding keeps the waste to
         # under one LANE_QUANTUM of identity terms
@@ -488,12 +589,14 @@ class TpuBackend(VerifierBackend):
                 msm.scalars_to_signed_digits(
                     scalars + [0] * (m_pad - len(scalars)), c)
             )
+        _note_marshal(t0)
         if self._sharded_msm is not None:
             return bool(self._sharded_msm(pts, digits, c))
         return chunked_msm_identity(c, pts, digits)
 
     def verify_each(self, rows: list[BatchRow]) -> list[bool]:
         n = len(rows)
+        t0 = time.perf_counter()
         pad = _pad_lanes(n)
         _note_pad_waste(n, pad)
         shared = all(r.g == rows[0].g and r.h == rows[0].h for r in rows)
@@ -508,6 +611,7 @@ class TpuBackend(VerifierBackend):
         r2 = _elems_soa([r.r2 for r in rows], pad)
         ws = _windows([r.s.value for r in rows], pad)
         wc = _windows([r.c.value for r in rows], pad)
+        _note_marshal(t0)
 
         if self._sharded_each is not None and shared:
             mask = self._sharded_each(g, h, y1, y2, r1, r2, ws, wc)
@@ -517,6 +621,7 @@ class TpuBackend(VerifierBackend):
             for lo, hi in _chunk_bounds(pad):
                 cg = g if shared else _chunk_point(g, lo, hi)
                 ch_ = h if shared else _chunk_point(h, lo, hi)
+                _jit_first_sight("each", hi - lo, shared)
                 chunks.append(_each_shared(
                     hi - lo, cg, ch_,
                     _chunk_point(y1, lo, hi), _chunk_point(y2, lo, hi),
@@ -524,6 +629,7 @@ class TpuBackend(VerifierBackend):
                     ws[:, lo:hi], wc[:, lo:hi]))
             mask = jnp.concatenate(chunks, axis=-1)
         else:
+            _jit_first_sight("each", pad, shared)
             mask = _each_shared(pad, g, h, y1, y2, r1, r2, ws, wc)
         if hasattr(mask, "is_fully_addressable") and not mask.is_fully_addressable:
             # multi-host job: the [n]-sharded result spans devices owned by

@@ -83,14 +83,42 @@ def pad_windows(w: jnp.ndarray, n_to: int) -> jnp.ndarray:
 def _mesh_step(d: int, n: int) -> tuple[int, int]:
     """(step, n_to): the per-slice lane count d*LANE_CHUNK that keeps every
     per-device program at or under the TPU large-lane miscompile bound
-    (ops/backend.py LANE_CHUNK), and the padded total — a d-multiple below
-    one step, a step-multiple above.  Single source for all three sharded
-    wrappers."""
+    (ops/backend.py LANE_CHUNK), and the padded total.  Single source for
+    all three sharded wrappers.
+
+    Padding is a d-multiple in BOTH regimes (ROADMAP item 2 fix): below
+    one step, the next d-multiple; above, each device's lane count is
+    rounded up to a LANE_QUANTUM multiple instead of a full LANE_CHUNK —
+    the old full-step rounding burned up to d*LANE_CHUNK-1 identity lanes
+    (2x device work at one-past-a-step sizes, e.g. 140k rows on 8 chips
+    padded 262,144 instead of 147,456).  The remainder slice is shorter
+    than ``step`` but stays a d-multiple with quantum-aligned per-device
+    programs, so the jit cache stays bounded exactly like the
+    single-device remainder-chunk schedule."""
     from ..ops import backend as _backend  # lazy: no import cycle
 
     step = d * _backend.LANE_CHUNK
-    n_to = -(-n // d) * d if n <= step else -(-n // step) * step
+    if n <= step:
+        n_to = -(-n // d) * d
+    else:
+        q = min(_backend.LANE_QUANTUM, _backend.LANE_CHUNK)
+        per_device = -(-n // d)               # ceil lanes per device
+        per_device = -(-per_device // q) * q  # quantum-align its program
+        n_to = per_device * d
+    _note_occupancy(n, n_to)
     return step, n_to
+
+
+def _note_occupancy(n: int, n_to: int) -> None:
+    """Mesh lane-occupancy telemetry (``tpu.batch.occupancy``): true rows
+    over padded mesh lanes.  Metrics live in the server layer; this
+    module stays importable without it."""
+    try:
+        from ..server import metrics
+
+        metrics.gauge("tpu.batch.occupancy").set(n / n_to if n_to else 1.0)
+    except Exception:  # pragma: no cover - server layer unavailable
+        pass
 
 
 def _point_specs(spec):
@@ -141,7 +169,8 @@ def make_sharded_verify_each(mesh: Mesh):
             return fn(g, h, y1, y2, r1, r2, ws, wc)[:n]
         chunks = []
         for lo in range(0, n_to, step):
-            hi = lo + step
+            # the last slice may be a short (but d-multiple) remainder
+            hi = min(lo + step, n_to)
             chunks.append(fn(
                 g, h,
                 *(tuple(c[..., lo:hi] for c in p) for p in (y1, y2, r1, r2)),
@@ -189,7 +218,7 @@ def make_sharded_prove(mesh: Mesh):
         if n_to <= step:
             b1, b2 = fn(tg, th, digits)
             return b1[:, :n], b2[:, :n]
-        parts = [fn(tg, th, digits[:, lo:lo + step])
+        parts = [fn(tg, th, digits[:, lo:min(lo + step, n_to)])
                  for lo in range(0, n_to, step)]
         b1 = jnp.concatenate([p[0] for p in parts], axis=-1)
         b2 = jnp.concatenate([p[1] for p in parts], axis=-1)
@@ -317,7 +346,7 @@ def make_sharded_msm_check(mesh: Mesh):
             parts = [
                 fn(tuple(cd[..., lo:hi] for cd in points), digits[:, lo:hi])
                 for lo, hi in (
-                    (lo, lo + step) for lo in range(0, m_to, step))
+                    (lo, min(lo + step, m_to)) for lo in range(0, m_to, step))
             ]
             partials = _backend._stack_partials(parts)
         return _backend._partials_are_identity(partials)

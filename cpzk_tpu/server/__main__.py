@@ -153,6 +153,9 @@ HELP = """Available commands:
   /status      (/st)  server status summary (incl. backend breaker state)
   /overload    (/ov)  admission status: level, tiers, clients, pushback
   /tracez [N]  (/tz)  last N completed request traces w/ stage breakdown
+  /flightrec [N] (/fr) last N device batches: occupancy, dispatch gap,
+                      thread_hop/marshal/compile/execute split, jit hits
+  /profile S [DIR]    capture S seconds of jax.profiler (xprof) trace
   /persist     (/wal) durability status: WAL size, fsync age, covered seq
   /users       (/u)   registered user count
   /sessions    (/s)   active session count
@@ -226,6 +229,48 @@ async def handle_command(
         except ValueError:
             return f"usage: /tracez [N] — not a number: {parts[1]}", False
         return format_tracez(get_tracer().completed(), limit=max(1, limit)), False
+    if word in ("/flightrec", "/fr"):
+        from ..observability import format_flightrec, get_flight_recorder
+
+        parts = cmd.split()
+        try:
+            limit = int(parts[1]) if len(parts) > 1 else 20
+        except ValueError:
+            return f"usage: /flightrec [N] — not a number: {parts[1]}", False
+        return format_flightrec(
+            get_flight_recorder().snapshot(), limit=max(1, limit)
+        ), False
+    if word in ("/profile", "/prof"):
+        from ..observability import flightrec as flightrec_mod
+
+        parts = cmd.split()
+        if len(parts) < 2:
+            return "usage: /profile <seconds> [dir]", False
+        try:
+            seconds = float(parts[1])
+        except ValueError:
+            return f"usage: /profile <seconds> [dir] — not a number: {parts[1]}", False
+        if not 0 < seconds <= 600:
+            return "profile duration must be in (0, 600] seconds", False
+        logdir = parts[2] if len(parts) > 2 else (
+            f"/tmp/cpzk-xprof-{int(time.time())}"
+        )
+        if not flightrec_mod.start_profile(logdir):
+            return (
+                f"a profile capture is already running "
+                f"(into {flightrec_mod.profile_active()}); wait for it",
+                False,
+            )
+        try:
+            await asyncio.sleep(seconds)
+        finally:
+            flightrec_mod.stop_profile()
+        return (
+            f"xprof capture ({seconds:g}s) written to {logdir} — inspect "
+            f"with: tensorboard --logdir {logdir} (Profile tab, Trace "
+            f"Viewer; the cpzk.* annotations match /tracez stage names)",
+            False,
+        )
     if word in ("/persist", "/wal"):
         if durability is None or durability.wal is None:
             return (
@@ -405,6 +450,24 @@ async def amain(args) -> None:
     loop = asyncio.get_running_loop()
     for sig in (signal.SIGINT, signal.SIGTERM):
         loop.add_signal_handler(sig, stop.set)
+
+    def dump_flightrec() -> None:
+        """SIGUSR2: dump the flight-recorder ring as JSON — the live-
+        incident snapshot (``kill -USR2 <pid>``), no REPL needed."""
+        from ..observability import get_flight_recorder
+
+        path = os.environ.get(
+            "CPZK_FLIGHTREC_DUMP", f"/tmp/cpzk-flightrec-{os.getpid()}.json"
+        )
+        try:
+            get_flight_recorder().dump(path)
+            log.info("flight recorder dumped to %s", path)
+        except OSError:
+            log.exception("flight recorder dump to %s failed", path)
+
+    with contextlib.suppress(NotImplementedError, ValueError, AttributeError):
+        # absent on platforms without SIGUSR2 (windows) — REPL still works
+        loop.add_signal_handler(signal.SIGUSR2, dump_flightrec)
 
     async def repl():
         print(_c("cyan", "Admin REPL ready. Type /help for commands."))

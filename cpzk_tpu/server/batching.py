@@ -32,6 +32,13 @@ histogram) plus batch-level ``pad_and_pack`` / ``device_dispatch`` /
 ``unpack`` stage spans via :class:`~cpzk_tpu.observability.BatchStages`,
 with ``tpu.batch.host_time`` / ``tpu.batch.device_time`` histograms —
 the latency-breakdown substrate docs/operations.md §Telemetry documents.
+
+Flight recording: every dispatch additionally lands one
+:class:`~cpzk_tpu.observability.flightrec.FlightRecord` — the widened
+``thread_hop``/``marshal``/``compile``/``execute`` split of where
+``device_dispatch`` time went, padded-lane occupancy, jit cache
+attribution, and the device dispatch gap — behind the admin REPL's
+``/flightrec`` and the SIGUSR2 JSON dump.
 """
 
 from __future__ import annotations
@@ -165,9 +172,13 @@ class DynamicBatcher:
         if self._stopping or self._task is None or self._task.done():
             # shutdown window (stop() ran but the listener is still up) or
             # batcher never started: verify inline with identical semantics
-            return await asyncio.to_thread(
-                self._verify, entries, self._stages_for(entries)
-            )
+            # (flight-recorded too — the inline path is still a dispatch)
+            stages = self._stages_for(entries)
+            t0 = time.monotonic()
+            stages.mark_submit()
+            results = await asyncio.to_thread(self._verify, entries, stages)
+            stages.finalize(time.monotonic() - t0)
+            return results
         # backpressure over the whole pipeline: queued entries PLUS entries
         # already claimed by in-flight dispatches — otherwise a deep
         # pipeline accepts up to pipeline_depth*max_batch extra work the
@@ -355,28 +366,37 @@ class DynamicBatcher:
         name = type(backend).__name__.removesuffix("Backend").lower()
         return name or "custom"
 
-    def _stages_for(self, entries: list[BatchEntry]) -> BatchStages:
+    def _stages_for(
+        self, entries: list[BatchEntry], queue_wait_s: float = 0.0
+    ) -> BatchStages:
         return BatchStages(
             get_tracer(),
             [e.trace_id for e in entries],
             batch_size=len(entries),
             backend_label=self._backend_label(),
+            queue_wait_s=queue_wait_s,
         )
 
-    def _note_queue_wait(self, entries: list[BatchEntry]) -> None:
+    def _note_queue_wait(self, entries: list[BatchEntry]) -> float:
         """queue_wait span + histogram per entry, measured from enqueue to
-        the moment its batch is committed to dispatch."""
+        the moment its batch is committed to dispatch; returns the mean
+        wait (the flight record's ``queue_wait_s``)."""
         now = time.monotonic()
         tracer = get_tracer()
         hist = metrics.histogram("tpu.batch.queue_wait")
+        total = 0.0
+        seen = 0
         for entry in entries:
             if entry.enqueued_at is None:
                 continue
             wait = max(0.0, now - entry.enqueued_at)
+            total += wait
+            seen += 1
             hist.observe(wait)
             tracer.add_span(
                 entry.trace_id, "queue_wait", entry.enqueued_at, wait
             )
+        return total / seen if seen else 0.0
 
     async def _dispatch(self, take: list[tuple[BatchEntry, asyncio.Future]]) -> None:
         # entries can also expire between the drain-loop slice and this
@@ -391,25 +411,43 @@ class DynamicBatcher:
         futs = [f for _, f in take]
         metrics.gauge("tpu.batch.fill_ratio").set(len(entries) / self.max_batch)
         metrics.counter("tpu.batch.proofs").inc(len(entries))
-        self._note_queue_wait(entries)
-        t0 = time.perf_counter()
+        mean_wait = self._note_queue_wait(entries)
+        stages = self._stages_for(entries, queue_wait_s=mean_wait)
+        t0 = time.monotonic()  # same clock as the stage spans, so the
+        stages.mark_submit()   # stage-sum-vs-wall invariant is exact
         try:
-            results = await asyncio.to_thread(
-                self._verify, entries, self._stages_for(entries)
-            )
+            results = await asyncio.to_thread(self._verify, entries, stages)
         except Exception as exc:  # backend blew up past all failovers
             log.exception("batch dispatch failed")
             for fut in futs:
                 if not fut.done():
                     fut.set_exception(exc)
             return
-        metrics.histogram("tpu.batch.latency").observe(time.perf_counter() - t0)
+        wall = time.monotonic() - t0
+        metrics.histogram("tpu.batch.latency").observe(wall)
+        # flight record: the widened stage breakdown, padded-shape
+        # occupancy, jit attribution, and dispatch gap for this batch
+        stages.finalize(wall)
         for fut, res in zip(futs, results, strict=True):
             if not fut.done():
                 fut.set_result(res)
 
     def _verify(
         self, entries: list[BatchEntry], stages: BatchStages | None = None
+    ) -> list[Error | None]:
+        if stages is not None:
+            # bracket the worker-thread interval: thread_hop (submit ->
+            # pickup, the per-batch asyncio.to_thread cost) on entry, the
+            # flight record's wall endpoint on exit
+            stages.mark_worker_start()
+            try:
+                return self._verify_inner(entries, stages)
+            finally:
+                stages.mark_worker_end()
+        return self._verify_inner(entries, stages)
+
+    def _verify_inner(
+        self, entries: list[BatchEntry], stages: BatchStages | None
     ) -> list[Error | None]:
         bv = BatchVerifier(backend=self.backend, max_size=max(len(entries), 1))
         bv.entries.extend(entries)  # already validated at RPC ingress

@@ -84,6 +84,11 @@ class ObservabilitySettings:
     trace_ring: int = 256          # completed traces kept for /tracez
     latency_buckets_ms: str = ""   # comma-separated upper bounds in ms;
                                    # empty keeps the built-in schedule
+    flight_ring: int = 512         # device batches kept for /flightrec
+                                   # and the SIGUSR2 JSON dump
+    compile_storm_threshold: int = 8  # first-sight jit compiles per 60s
+                                      # window that trigger the
+                                      # compile-storm WARNING
 
     def parsed_buckets(self) -> list[float]:
         """Bucket bounds in SECONDS from the ms-denominated config string
@@ -325,6 +330,10 @@ class ServerConfig:
             self.observability.trace_ring = int(v)
         if (v := get_alias("OBSERVABILITY_LATENCY_BUCKETS_MS", "OBS_LATENCY_BUCKETS_MS")) is not None:
             self.observability.latency_buckets_ms = v
+        if (v := get_alias("OBSERVABILITY_FLIGHT_RING", "OBS_FLIGHT_RING")) is not None:
+            self.observability.flight_ring = int(v)
+        if (v := get_alias("OBSERVABILITY_COMPILE_STORM_THRESHOLD", "OBS_COMPILE_STORM_THRESHOLD")) is not None:
+            self.observability.compile_storm_threshold = int(v)
         # durability knobs (snapshot + write-ahead log)
         if (v := get("DURABILITY_ENABLED")) is not None:
             self.durability.enabled = v.lower() in ("1", "true", "yes", "on")
@@ -423,6 +432,12 @@ class ServerConfig:
         ):
             raise ValueError(
                 "observability.slow_request_ms must be >= 0, or -1 to disable"
+            )
+        if self.observability.flight_ring < 1:
+            raise ValueError("observability.flight_ring must be >= 1")
+        if self.observability.compile_storm_threshold < 1:
+            raise ValueError(
+                "observability.compile_storm_threshold must be >= 1"
             )
         if self.durability.fsync not in ("always", "interval", "off"):
             raise ValueError(

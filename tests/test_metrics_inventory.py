@@ -121,6 +121,27 @@ assert metrics.read("noop.test.labeled", labels={"rpc": "Y"}) == 0.0
 
 assert metrics.start_exporter("127.0.0.1", 0) is False
 assert ("c", "noop.test.count") in metrics.registered()
+
+# flight-recorder metric families work on the no-op backing too: the
+# labeled jit cache/compile counters, dispatch-gap histogram, busy
+# fraction + occupancy + throughput gauges
+from cpzk_tpu.observability import flightrec
+
+flightrec.note_jit("combined/1024", True)
+flightrec.note_jit("combined/1024", False)
+assert metrics.read("tpu.jit.cache", labels={"outcome": "miss"}) == 1.0
+assert metrics.read("tpu.jit.cache", labels={"outcome": "hit"}) == 1.0
+assert metrics.read("tpu.jit.compiles", labels={"shape": "combined/1024"}) == 1.0
+
+rec = flightrec.get_flight_recorder()
+rec.note_device_interval(1.0, 1.5)
+rec.note_device_interval(2.0, 2.25)
+assert metrics.read_histogram("tpu.dispatch.gap") == (2.0, 0.5)
+assert metrics.read("tpu.device.busy_fraction", "g") > 0.0
+
+rec.record(flightrec.FlightRecord(batch=8, lanes=16, occupancy=0.5))
+assert metrics.read("tpu.batch.occupancy", "g") == 0.5
+assert metrics.read("tpu.throughput.proofs_per_s", "g") >= 0.0
 print("NOOP-OK")
 """
 
