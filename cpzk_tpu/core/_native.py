@@ -249,6 +249,31 @@ def batch_decode(wires: bytes, threads: int = 0) -> tuple[bytes, bytes] | None:
     return coords.raw, ok.raw
 
 
+def batch_decode_into(wires: bytes, coords, ok, threads: int = 0) -> bool | None:
+    """Allocation-free variant of :func:`batch_decode`: the coordinate and
+    flag outputs land directly in caller-provided writable C-contiguous
+    buffers (numpy uint8 arrays), so a hot marshal loop can reuse one
+    staging buffer per batch shape instead of paying two
+    ``create_string_buffer`` allocations plus two ``.raw`` copies (129
+    bytes/point) per call.  ``coords`` must hold >= 128*n bytes and ``ok``
+    >= n bytes for n = len(wires)/32.  Returns True on dispatch, None when
+    the library is unavailable (caller falls back)."""
+    lib = _ristretto_lib()
+    if lib is None or not hasattr(lib, "cpzk_batch_decode"):
+        return None
+    if len(wires) % 32:
+        raise ValueError("wires must be a multiple of 32 bytes")
+    n = len(wires) // 32
+    if coords.nbytes < 128 * n or ok.nbytes < n:
+        raise ValueError("staging buffers too small for the wire count")
+    cbuf = (ctypes.c_char * (128 * n)).from_buffer(coords)
+    obuf = (ctypes.c_char * n).from_buffer(ok)
+    if threads <= 0:
+        threads = min(os.cpu_count() or 1, max(1, n // 256 + 1))
+    lib.cpzk_batch_decode(n, wires, cbuf, obuf, threads)
+    return True
+
+
 def parse_proofs(packed: bytes, deep: bool = True,
                  threads: int = 0) -> bytes | None:
     """Fast-path validation of n packed 109-byte proof wires (the only

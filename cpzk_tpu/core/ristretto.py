@@ -182,6 +182,22 @@ class Ristretto255:
         return Scalar(scalars.sc_from_bytes_mod_order_wide(rng.fill_bytes(WIDE_REDUCTION_BYTES)))
 
     @staticmethod
+    def random_scalars(rng: SecureRng, n: int) -> list[Scalar]:
+        """``n`` independent uniform scalars from ONE CSPRNG draw.  Each
+        per-scalar ``fill_bytes`` is a getrandom(2) syscall; the batch
+        verifier draws one RLC coefficient per row, so at device batch
+        sizes the per-row syscall (not the wide reduction) dominates the
+        host prep — one pooled draw sliced into 64-byte windows keeps the
+        distribution identical and the syscall count at 1."""
+        pool = rng.fill_bytes(WIDE_REDUCTION_BYTES * n)
+        return [
+            Scalar(scalars.sc_from_bytes_mod_order_wide(
+                pool[WIDE_REDUCTION_BYTES * i: WIDE_REDUCTION_BYTES * (i + 1)]
+            ))
+            for i in range(n)
+        ]
+
+    @staticmethod
     def scalar_mul(element: Element, scalar: Scalar) -> Element:
         """scalar * element for PUBLIC inputs, through the C++ host core
         when available (bit-exact vs the Python path per

@@ -86,6 +86,33 @@ class Transcript:
         return Scalar(sc_from_bytes_mod_order_wide(buf))
 
 
+_DEVICE_CHALLENGES_WARNED = False
+
+
+def _warn_device_challenges_removed() -> None:
+    """One-time deprecation notice: deployments still setting
+    CPZK_DEVICE_CHALLENGES=1 silently fall through to the host pool (the
+    device-Keccak path was removed after round-5 calibration measured it
+    18-37x slower than the threaded native derivation) — say so once
+    instead of letting the knob rot unnoticed in a config template."""
+    global _DEVICE_CHALLENGES_WARNED
+    if _DEVICE_CHALLENGES_WARNED:
+        return
+    _DEVICE_CHALLENGES_WARNED = True
+    import os
+
+    if os.environ.get("CPZK_DEVICE_CHALLENGES") == "1":
+        import warnings
+
+        warnings.warn(
+            "CPZK_DEVICE_CHALLENGES=1 is set, but the device-challenge "
+            "path was removed after hardware calibration (18-37x slower "
+            "than the threaded host pool at every measured tier); "
+            "challenges derive on the host pool — drop the env var",
+            stacklevel=3,
+        )
+
+
 def derive_challenges_batch(
     contexts: list[bytes | None],
     gs: list[bytes],
@@ -112,6 +139,7 @@ def derive_challenges_batch(
     # proofs/s, which one host core already triples).  The kernel itself
     # survives as :mod:`cpzk_tpu.ops.challenge` (device Keccak-f[1600]
     # twin, differential-tested) for silicon where the trade flips.
+    _warn_device_challenges_removed()
     out = _native.challenge_batch(
         contexts,
         b"".join(gs), b"".join(hs),

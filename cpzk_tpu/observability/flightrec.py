@@ -52,16 +52,22 @@ SCHEMA = "cpzk-flightrec/1"
 
 #: Stage vocabulary widening (the split of PR-2's ``device_dispatch``).
 STAGE_THREAD_HOP = "thread_hop"
+STAGE_DEVICE_WAIT = "device_wait"
 STAGE_MARSHAL = "marshal"
 STAGE_COMPILE = "compile"
 STAGE_EXECUTE = "execute"
 
 #: Stage keys of one flight record, dispatch order.  ``queue_wait`` is
 #: carried separately (per-entry mean) — these tile the submit->resolve
-#: wall time, which is the sum invariant the tests pin.
+#: wall time, which is the sum invariant the tests pin.  ``device_wait``
+#: is the dispatch lane's staging-slot dwell: a host-prepared batch
+#: waiting for the device thread to finish the previous batch (near the
+#: previous batch's device time under double-buffered overlap, ~0 when
+#: the device is the idle side).
 RECORD_STAGES = (
     STAGE_THREAD_HOP,
     "pad_and_pack",
+    STAGE_DEVICE_WAIT,
     STAGE_MARSHAL,
     STAGE_COMPILE,
     STAGE_EXECUTE,

@@ -36,6 +36,7 @@ from . import flightrec
 from .context import RequestContext, new_trace_id
 from .flightrec import (
     STAGE_COMPILE,
+    STAGE_DEVICE_WAIT,
     STAGE_EXECUTE,
     STAGE_MARSHAL,
     STAGE_THREAD_HOP,
@@ -160,6 +161,29 @@ class Tracer:
                     SpanRecord(name, start, max(0.0, duration_s), dict(attrs))
                 )
 
+    def add_span_many(
+        self,
+        trace_ids: list[str],
+        name: str,
+        start: float,
+        duration_s: float,
+        **attrs,
+    ) -> None:
+        """One batch-stage span fanned out to every member trace under a
+        SINGLE lock acquisition, with one shared (never mutated) attrs
+        dict.  A device batch coalesces hundreds of RPCs and emits ~6
+        stages each — per-trace locking made the fan-out itself a
+        milliseconds-scale slice of the dispatch wall that no stage span
+        covered."""
+        if not trace_ids:
+            return
+        dur = max(0.0, duration_s)
+        with self._lock:
+            for tid in trace_ids:
+                rec = self._active.get(tid)
+                if rec is not None:
+                    rec.spans.append(SpanRecord(name, start, dur, attrs))
+
     def finish(
         self, trace_id: str, status: str, duration_s: float | None = None
     ) -> TraceRecord | None:
@@ -268,6 +292,7 @@ class BatchStages:
         #: accumulated seconds per stage name (incl. the widened vocab)
         self.durations: dict[str, float] = {}
         self._submitted_at: float | None = None
+        self._staged_at: float | None = None
         self._worker_ended_at: float | None = None
         self._sink: flightrec.DeviceSink | None = None
         self._gap_s = 0.0
@@ -276,19 +301,39 @@ class BatchStages:
 
     def mark_submit(self) -> None:
         """Stamp the dispatch commit (event-loop side, just before the
-        batch crosses to a worker thread)."""
+        batch crosses to the dispatch lane or a worker thread)."""
         self._submitted_at = time.monotonic()
 
     def mark_worker_start(self) -> None:
         """Stamp worker-thread pickup; the elapsed time since
         :meth:`mark_submit` is the ``thread_hop`` span — the per-batch
-        cost of the ``asyncio.to_thread`` seam."""
+        cost of crossing the batcher->worker seam (a condition-variable
+        wakeup on the persistent dispatch lane; a thread-pool handoff on
+        the legacy ``asyncio.to_thread`` path)."""
         if self._submitted_at is None:
             return
         now = time.monotonic()
         dur = max(0.0, now - self._submitted_at)
         self._emit(STAGE_THREAD_HOP, now - dur, dur)
         metrics.histogram("tpu.batch.thread_hop").observe(dur)
+
+    def mark_staged(self) -> None:
+        """Stamp host-prep completion (the batch entering a dispatch-lane
+        staging slot, prepared but not yet on the device thread)."""
+        self._staged_at = time.monotonic()
+
+    def mark_device_start(self) -> None:
+        """Stamp device-thread pickup; the elapsed time since
+        :meth:`mark_staged` is the ``device_wait`` span — staging-slot
+        dwell while the device thread finishes the previous batch (the
+        double-buffering overlap made visible).  No-op when the batch
+        never entered a staging slot (single-thread inline verify)."""
+        if self._staged_at is None:
+            return
+        now = time.monotonic()
+        dur = max(0.0, now - self._staged_at)
+        self._emit(STAGE_DEVICE_WAIT, now - dur, dur)
+        metrics.histogram("tpu.batch.device_wait").observe(dur)
 
     def mark_worker_end(self) -> None:
         """Stamp verify completion on the worker thread; the record's
@@ -300,12 +345,11 @@ class BatchStages:
     def _emit(self, name: str, start: float, dur: float, **attrs) -> None:
         self.durations[name] = self.durations.get(name, 0.0) + dur
         if self.tracer is not None:
-            for tid in self.trace_ids:
-                self.tracer.add_span(
-                    tid, name, start, dur,
-                    batch=self.batch_size, backend=self.backend_label,
-                    **attrs,
-                )
+            self.tracer.add_span_many(
+                self.trace_ids, name, start, dur,
+                batch=self.batch_size, backend=self.backend_label,
+                **attrs,
+            )
 
     @contextmanager
     def stage(self, name: str):

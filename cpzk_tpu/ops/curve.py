@@ -29,6 +29,8 @@ would lower a lane-crossing gather.
 
 from __future__ import annotations
 
+import threading
+
 import numpy as np
 
 import jax.numpy as jnp
@@ -58,28 +60,55 @@ def points_to_device(points: list[host_edwards.Point]) -> Point:
     return (jnp.asarray(xs), jnp.asarray(ys), jnp.asarray(zs), jnp.asarray(ts))
 
 
+#: Per-thread reusable decode staging (coords + ok buffers per padded
+#: shape).  The serving dispatch lane marshals every batch on ONE
+#: persistent device thread, so each shape's 129*pad-byte staging pair is
+#: allocated once and reused for the lifetime of the lane instead of per
+#: batch; other threads (direct BatchVerifier users, tests) get their own
+#: pool.  Bounded: the lane padding schedule keeps live shapes to a
+#: handful, and the pool evicts FIFO past that.
+_STAGING = threading.local()
+_STAGING_SHAPES_MAX = 8
+
+
+def _staging_buffers(pad: int) -> tuple[np.ndarray, np.ndarray]:
+    pool = getattr(_STAGING, "pool", None)
+    if pool is None:
+        pool = _STAGING.pool = {}
+    bufs = pool.get(pad)
+    if bufs is None:
+        while len(pool) >= _STAGING_SHAPES_MAX:
+            pool.pop(next(iter(pool)))
+        bufs = pool[pad] = (
+            np.empty((pad, 4, 32), dtype=np.uint8),
+            np.empty((pad,), dtype=np.uint8),
+        )
+    return bufs
+
+
 def wires_to_device(wires: bytes, pad: int) -> Point | None:
     """n concatenated 32-byte wire encodings -> SoA limb arrays
     [20, pad] x 4, decoding on the native worker pool (~340 us/point of
     Python big-int decode avoided — the serving-path marshalling
-    bottleneck).  Identity-pads to ``pad`` columns.  Returns None when
-    the native core is unavailable (caller falls back to the Python
-    path); raises on an invalid encoding (callers marshal elements that
-    already passed parse-time validation, so this is a can't-happen
-    guard, not a validation layer)."""
+    bottleneck) directly into the calling thread's reusable staging
+    buffers (no per-batch coordinate-buffer allocation).  Identity-pads
+    to ``pad`` columns.  Returns None when the native core is unavailable
+    (caller falls back to the Python path); raises on an invalid encoding
+    (callers marshal elements that already passed parse-time validation,
+    so this is a can't-happen guard, not a validation layer)."""
     from ..core import _native
     from ..errors import InvalidGroupElement
 
     n = len(wires) // 32
     if pad > n:
         wires = wires + bytes(32) * (pad - n)  # identity wire is all-zero
-    out = _native.batch_decode(wires)
-    if out is None:
+    rows, ok = _staging_buffers(pad)
+    if _native.batch_decode_into(wires, rows, ok) is None:
         return None
-    coords, ok = out
-    if ok != b"\x01" * pad:
+    if not (ok == 1).all():
         raise InvalidGroupElement("batch decode of pre-validated wire failed")
-    rows = np.frombuffer(coords, dtype=np.uint8).reshape(pad, 4, 32)
+    # bytes_to_limbs materializes fresh limb arrays, so the staging rows
+    # are free for reuse the moment this returns
     return tuple(
         jnp.asarray(limbs.bytes_to_limbs(np.ascontiguousarray(rows[:, k, :])))
         for k in range(4)

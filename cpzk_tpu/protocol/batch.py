@@ -29,7 +29,7 @@ north star).
 from __future__ import annotations
 
 import contextlib
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 
 from ..errors import Error, InvalidParams, InvalidProofEncoding
 from ..core import edwards
@@ -89,6 +89,30 @@ class BatchRow:
     s: Scalar
     c: Scalar
     alpha: Scalar
+
+
+@dataclass
+class PreparedBatch:
+    """Host-phase output of :meth:`BatchVerifier.prepare_batch` — the
+    challenge-resolved rows (or the n == 1 verifier, or the deferred-parse
+    splice plan) ready for backend dispatch via
+    :meth:`BatchVerifier.run_prepared`.  Built on one thread, consumable
+    on another: nothing here touches the backend or the RNG."""
+
+    n: int
+    # n == 1 individual-verification path
+    entry: BatchEntry | None = None
+    verifier: object | None = None      # protocol.verifier.Verifier
+    transcript: Transcript | None = None
+    # n >= 2 batch path
+    rows: list[BatchRow] | None = None
+    beta: Scalar | None = None
+    same_generators: bool = True
+    # deferred-parse splice path: undecodable wires mapped to their parse
+    # errors; survivors prepared as a sub-batch
+    pre_errors: dict[int, Error] | None = None
+    sub: "BatchVerifier | None" = None
+    sub_prepared: "PreparedBatch | None" = field(default=None, repr=False)
 
 
 class VerifierBackend:
@@ -495,8 +519,14 @@ class BatchVerifier:
             [Ristretto255.element_to_bytes(e.proof.commitment.r1) for e in self.entries],
             [Ristretto255.element_to_bytes(e.proof.commitment.r2) for e in self.entries],
         )
+        # RLC coefficients from one pooled CSPRNG draw: a per-row
+        # random_scalar() is a getrandom(2) syscall each, which at device
+        # batch sizes costs more host time than the wide reductions
+        alphas = Ristretto255.random_scalars(rng, len(self.entries))
         rows = []
-        for entry, c in zip(self.entries, challenges, strict=True):
+        for entry, c, alpha in zip(
+            self.entries, challenges, alphas, strict=True
+        ):
             rows.append(
                 BatchRow(
                     g=entry.params.generator_g,
@@ -507,7 +537,7 @@ class BatchVerifier:
                     r2=entry.proof.commitment.r2,
                     s=entry.proof.response.s,
                     c=c,
-                    alpha=Ristretto255.random_scalar(rng),
+                    alpha=alpha,
                 )
             )
         return rows
@@ -531,66 +561,129 @@ class BatchVerifier:
         verification; otherwise (and always for n == 1 or the combined
         fast path) they are screened eagerly first, so every path yields
         the exact eager-parse error for an undecodable wire.
+
+        Composes :meth:`prepare_batch` (host phase) with
+        :meth:`run_prepared` (device phase) — the two-phase seam the
+        serving layer's dispatch lane uses to overlap batch N+1's host
+        prep with batch N's device compute.  Calling ``verify`` runs both
+        phases back-to-back on the current thread.
         """
+        st = stages if stages is not None else _NULL_STAGES
+        return self.run_prepared(self.prepare_batch(rng, st), st)
+
+    def prepare_batch(self, rng: SecureRng, stages=None) -> "PreparedBatch":
+        """Host phase: deferred-parse screening, Fiat-Shamir challenge
+        derivation, RLC coefficient draws, and (n == 1) verifier/transcript
+        construction — everything that does not touch the backend.  Timed
+        under the ``pad_and_pack`` stage.  The returned
+        :class:`PreparedBatch` is consumed by :meth:`run_prepared`, on the
+        same thread or another one (the dispatch lane's device thread)."""
         if not self.entries:
             raise InvalidParams("Cannot verify empty batch")
         st = stages if stages is not None else _NULL_STAGES
         n = len(self.entries)
         backend = self.backend
-        same_generators = all(
-            e.params.generator_g == self.entries[0].params.generator_g
-            and e.params.generator_h == self.entries[0].params.generator_h
-            for e in self.entries
-        )
-        has_deferred = any(e.proof.deferred for e in self.entries)
-        if has_deferred and (
-            n == 1
-            or not same_generators
-            or not backend.supports_deferred_decode
-            or backend.prefers_combined
-        ):
-            pre_errors = self._screen_deferred()
-            if pre_errors:
-                # keep undecodable wires away from the backend: verify the
-                # survivors as their own batch and splice results back
-                sub = BatchVerifier(backend=self._backend,
-                                    max_size=max(self.max_size, 1))
-                sub.entries = [e for i, e in enumerate(self.entries)
-                               if i not in pre_errors]
-                sub_results = sub.verify(rng) if sub.entries else []
-                results, k = [], 0
-                for i in range(n):
-                    if i in pre_errors:
-                        results.append(pre_errors[i])
-                    else:
-                        results.append(sub_results[k])
-                        k += 1
-                return results
+        # one pad_and_pack bracket covers the WHOLE host phase — the
+        # generator-equality / deferred scans, screening, and row build —
+        # so the flight record's stage sum tiles its wall on every path
+        with st.stage("pad_and_pack"):
+            same_generators = all(
+                e.params.generator_g == self.entries[0].params.generator_g
+                and e.params.generator_h == self.entries[0].params.generator_h
+                for e in self.entries
+            )
+            has_deferred = any(e.proof.deferred for e in self.entries)
+            if has_deferred and (
+                n == 1
+                or not same_generators
+                or not backend.supports_deferred_decode
+                or backend.prefers_combined
+            ):
+                pre_errors = self._screen_deferred()
+                if pre_errors:
+                    # keep undecodable wires away from the backend:
+                    # prepare the survivors as their own batch (null
+                    # recorder — this bracket covers their host phase;
+                    # run_prepared brackets their device phase);
+                    # run_prepared splices results around the errors
+                    sub = BatchVerifier(backend=self._backend,
+                                        max_size=max(self.max_size, 1))
+                    sub.entries = [e for i, e in enumerate(self.entries)
+                                   if i not in pre_errors]
+                    sub_prepared = (
+                        sub.prepare_batch(rng) if sub.entries else None
+                    )
+                    return PreparedBatch(
+                        n=n, pre_errors=pre_errors, sub=sub,
+                        sub_prepared=sub_prepared,
+                    )
 
-        if n == 1:
-            # single-entry batches keep the same stage decomposition so a
-            # trace through a lightly-loaded batcher still breaks down
-            entry = self.entries[0]
-            with st.stage("pad_and_pack"):
+            if n == 1:
+                # single-entry batches keep the same stage decomposition
+                # so a trace through a lightly-loaded batcher still
+                # breaks down
+                entry = self.entries[0]
                 transcript = Transcript()
                 if entry.transcript_context is not None:
                     transcript.append_context(entry.transcript_context)
                 verifier = Verifier(entry.params, entry.statement)
+                return PreparedBatch(
+                    n=1, entry=entry, verifier=verifier,
+                    transcript=transcript,
+                )
+
+            rows = self.prepare_rows(rng)
+            beta = Ristretto255.random_scalar(rng)
+        return PreparedBatch(
+            n=n, rows=rows, beta=beta, same_generators=same_generators,
+        )
+
+    def run_prepared(
+        self, prepared: "PreparedBatch", stages=None
+    ) -> list[Error | None]:
+        """Device phase: backend dispatch (``device_dispatch`` stage) and
+        result assembly (``unpack``) for a :meth:`prepare_batch` output.
+        Accept/reject semantics are identical to :meth:`verify` — the
+        split changes WHERE the phases run, never what they compute."""
+        st = stages if stages is not None else _NULL_STAGES
+        backend = self.backend
+
+        if prepared.pre_errors is not None:
+            # the sub-batch's device phase records into THIS batch's
+            # stage recorder, so the splice path keeps the full
+            # decomposition (and the stage-sum≈wall invariant)
+            sub_results = (
+                prepared.sub.run_prepared(prepared.sub_prepared, st)
+                if prepared.sub is not None and prepared.sub_prepared is not None
+                else []
+            )
+            results: list[Error | None] = []
+            k = 0
+            for i in range(prepared.n):
+                if i in prepared.pre_errors:
+                    results.append(prepared.pre_errors[i])
+                else:
+                    results.append(sub_results[k])
+                    k += 1
+            return results
+
+        if prepared.n == 1:
+            entry = prepared.entry
             with st.stage("device_dispatch"):
                 try:
-                    verifier.verify_with_transcript(entry.proof, transcript)
+                    prepared.verifier.verify_with_transcript(
+                        entry.proof, prepared.transcript
+                    )
                     result: Error | None = None
                 except Error as exc:
                     result = exc
             with st.stage("unpack"):
                 return [result]
 
-        with st.stage("pad_and_pack"):
-            rows = self.prepare_rows(rng)
-            beta = Ristretto255.random_scalar(rng)
+        rows, beta = prepared.rows, prepared.beta
         with st.stage("device_dispatch"):
             if (
-                same_generators
+                prepared.same_generators
                 and backend.prefers_combined
                 and backend.verify_combined(rows, beta)
             ):

@@ -78,12 +78,22 @@ def parse_args(argv=None) -> argparse.Namespace:
 def build_backend(config):
     """(backend, batcher) for the resolved config: the TPU data plane behind
     a CPU failover and a dynamic batching queue, or (None, None) for the
-    reference-parity inline CPU path."""
+    reference-parity inline CPU path.  With ``[tpu] prewarm_quanta`` set,
+    the verify kernels for those batch sizes are AOT-compiled HERE — before
+    the listener binds and health reports ready — so the first serving
+    dispatch at a warmed shape never pays an XLA trace."""
     if config.tpu.backend != "tpu":
         return None, None
-    from ..ops.backend import TpuBackend
+    import jax
+
+    from ..ops.backend import TpuBackend, enable_donation, prewarm_executables
     from ..protocol.batch import CpuBackend, FailoverBackend
     from .batching import DynamicBatcher
+
+    # serving rebuilds every kernel input per batch, so donated buffers
+    # are safe here (and let XLA reuse device memory across batches);
+    # XLA CPU ignores donation and warns per call, so gate it off there
+    enable_donation(jax.default_backend() != "cpu")
 
     # mesh_devices semantics: 0 = shard over all visible devices (default),
     # k = first k devices; TpuBackend skips the mesh when only 1 is visible.
@@ -98,6 +108,15 @@ def build_backend(config):
         ),
         probe_batch_max=config.tpu.probe_batch_max,
     )
+    quanta = config.tpu.parsed_prewarm_quanta()
+    if quanta:
+        t0 = time.monotonic()
+        warmed = prewarm_executables(quanta)
+        log.info(
+            "prewarmed %d verify executables for batch quanta %s in %.1fs "
+            "(%s)", len(warmed), quanta, time.monotonic() - t0,
+            ", ".join(warmed) or "all cached",
+        )
     batcher = DynamicBatcher(
         backend,
         max_batch=config.tpu.batch_max,

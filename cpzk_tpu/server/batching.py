@@ -6,12 +6,13 @@ The reference verifies every ``VerifyProof`` inline on the request task
 amortizes only over large batches.  ``DynamicBatcher`` is the TPU-native
 serving piece: RPC handlers submit (params, statement, proof, context)
 entries and await a future; a single dispatcher task drains the queue every
-``window_ms`` (or immediately at ``max_batch``), runs one
-:class:`~cpzk_tpu.protocol.batch.BatchVerifier` pass on a worker thread
-(keeping the event loop responsive), and resolves the futures with per-entry
-results.  Accept/reject semantics are exactly the BatchVerifier ground
-truth, so batching is observationally identical to inline verification —
-only latency (+window) and throughput change.
+``window_ms`` (or immediately at ``max_batch``) and hands each batch to the
+:class:`~cpzk_tpu.server.dispatch.DispatchLane` — a persistent host-prep +
+device-dispatch thread pair (no per-batch ``asyncio.to_thread`` hop; batch
+N+1's host prep overlaps batch N's device compute), which resolves the
+futures with per-entry results.  Accept/reject semantics are exactly the
+BatchVerifier ground truth, so batching is observationally identical to
+inline verification — only latency (+window) and throughput change.
 
 Deadline shedding (resilience subsystem): each entry may carry the
 absolute monotonic deadline of the RPC that queued it; the dispatcher
@@ -35,26 +36,25 @@ the latency-breakdown substrate docs/operations.md §Telemetry documents.
 
 Flight recording: every dispatch additionally lands one
 :class:`~cpzk_tpu.observability.flightrec.FlightRecord` — the widened
-``thread_hop``/``marshal``/``compile``/``execute`` split of where
-``device_dispatch`` time went, padded-lane occupancy, jit cache
-attribution, and the device dispatch gap — behind the admin REPL's
-``/flightrec`` and the SIGUSR2 JSON dump.
+``thread_hop``/``device_wait``/``marshal``/``compile``/``execute``
+split of where ``device_dispatch`` time went, padded-lane occupancy,
+jit cache attribution, and the device dispatch gap — behind the admin
+REPL's ``/flightrec`` and the SIGUSR2 JSON dump.
 """
 
 from __future__ import annotations
 
 import asyncio
 import logging
-import os
-import sys
 import time
 
 from ..core.rng import SecureRng
 from ..errors import Error
 from ..observability.tracing import BatchStages, get_tracer
-from ..protocol.batch import BatchEntry, BatchVerifier, VerifierBackend
+from ..protocol.batch import BatchEntry, VerifierBackend
 from ..protocol.gadgets import Parameters, Proof, Statement
 from . import metrics
+from .dispatch import DispatchLane, LaneStopped
 
 log = logging.getLogger("cpzk_tpu.server.batching")
 
@@ -97,6 +97,10 @@ class DynamicBatcher:
         # numpy work) overlaps batch k's device compute.  Depth 1 restores
         # strictly serial dispatch.
         self.pipeline_depth = max(1, pipeline_depth)
+        # the persistent dispatch lane (created per start()): one host-prep
+        # thread + one device thread replacing the per-batch to_thread hop;
+        # depth 1 collapses it to a single strictly-serial lane thread
+        self._lane: DispatchLane | None = None
         self._inflight: asyncio.Semaphore | None = None
         # entries claimed by in-flight dispatches but not yet resolved;
         # counted into both backpressure and the depth gauge so pipelining
@@ -117,10 +121,21 @@ class DynamicBatcher:
     # -- lifecycle ---------------------------------------------------------
 
     def start(self) -> None:
+        if self._task is not None and not self._task.done():
+            return  # already running (serve() starts the batcher it is given)
+        self._lane = DispatchLane(
+            self.backend,
+            rng=self._rng,
+            overlap=self.pipeline_depth > 1,
+            staging_slots=max(1, self.pipeline_depth - 1),
+        )
+        self._lane.start()
         self._task = asyncio.get_running_loop().create_task(self._run())
 
     async def stop(self) -> None:
-        """Drain the queue and all in-flight dispatches, then stop."""
+        """Drain the queue and all in-flight dispatches, then stop —
+        including the dispatch lane, which drains its accepted batches
+        and resolves every pending future before its threads exit."""
         self._stopping = True
         self._wakeup.set()
         if self._task is not None:
@@ -128,6 +143,8 @@ class DynamicBatcher:
             self._task = None
         if self._dispatches:
             await asyncio.gather(*tuple(self._dispatches), return_exceptions=True)
+        if self._lane is not None:
+            await self._lane.stop()
 
     # -- submission --------------------------------------------------------
 
@@ -172,11 +189,17 @@ class DynamicBatcher:
         if self._stopping or self._task is None or self._task.done():
             # shutdown window (stop() ran but the listener is still up) or
             # batcher never started: verify inline with identical semantics
-            # (flight-recorded too — the inline path is still a dispatch)
+            # through the SAME dispatch seam the lane threads run
+            # (DispatchLane.verify_once), so the flight record still lands
+            # with the full stage decomposition — thread_hop here is the
+            # one-off to_thread handoff this fallback path actually pays
             stages = self._stages_for(entries)
             t0 = time.monotonic()
             stages.mark_submit()
-            results = await asyncio.to_thread(self._verify, entries, stages)
+            results = await asyncio.to_thread(
+                DispatchLane.verify_once,
+                self.backend, self._rng, entries, stages,
+            )
             stages.finalize(time.monotonic() - t0)
             return results
         # backpressure over the whole pipeline: queued entries PLUS entries
@@ -416,7 +439,7 @@ class DynamicBatcher:
         t0 = time.monotonic()  # same clock as the stage spans, so the
         stages.mark_submit()   # stage-sum-vs-wall invariant is exact
         try:
-            results = await asyncio.to_thread(self._verify, entries, stages)
+            results = await self._lane_verify(entries, stages)
         except Exception as exc:  # backend blew up past all failovers
             log.exception("batch dispatch failed")
             for fut in futs:
@@ -432,49 +455,19 @@ class DynamicBatcher:
             if not fut.done():
                 fut.set_result(res)
 
-    def _verify(
-        self, entries: list[BatchEntry], stages: BatchStages | None = None
-    ) -> list[Error | None]:
-        if stages is not None:
-            # bracket the worker-thread interval: thread_hop (submit ->
-            # pickup, the per-batch asyncio.to_thread cost) on entry, the
-            # flight record's wall endpoint on exit
-            stages.mark_worker_start()
-            try:
-                return self._verify_inner(entries, stages)
-            finally:
-                stages.mark_worker_end()
-        return self._verify_inner(entries, stages)
-
-    def _verify_inner(
+    async def _lane_verify(
         self, entries: list[BatchEntry], stages: BatchStages | None
     ) -> list[Error | None]:
-        bv = BatchVerifier(backend=self.backend, max_size=max(len(entries), 1))
-        bv.entries.extend(entries)  # already validated at RPC ingress
-        xprof = os.environ.get("CPZK_XPROF_DIR")
-        if xprof:
-            # JAX profiler (xprof) trace around the device dispatch —
-            # SURVEY.md §5 tracing/profiling TPU addition; inspect with
-            # tensorboard --logdir $CPZK_XPROF_DIR.  The per-stage
-            # TraceAnnotations emitted by ``stages`` nest inside this
-            # capture, so the xprof timeline carries the same
-            # pad_and_pack/device_dispatch/unpack names as /tracez.
-            import jax
-
-            with jax.profiler.trace(xprof):
-                with jax.profiler.TraceAnnotation("cpzk_batch_verify"):
-                    return bv.verify(self._rng, stages=stages)
-        if os.environ.get("CPZK_BATCH_DEBUG") == "1":
-            # stage decomposition for the gRPC-on-device collapse
-            # investigation (PROFILE.md §7c): per-batch wall split between
-            # BatchVerifier host prep (challenge derivation, alpha draws)
-            # and the backend call, printed from the worker thread
-            import time as _t
-
-            t0 = _t.perf_counter()
-            out = bv.verify(self._rng, stages=stages)
-            print(f"[batch-debug] n={len(entries)} "
-                  f"verify={_t.perf_counter() - t0:.3f}s",
-                  file=sys.stderr, flush=True)
-            return out
-        return bv.verify(self._rng, stages=stages)
+        """Route one committed batch through the dispatch lane; falls
+        back to a worker thread running the identical seam when the lane
+        is already draining (a dispatch committed in the same loop tick
+        as stop())."""
+        lane = self._lane
+        if lane is not None and lane.running:
+            try:
+                return await lane.submit(entries, stages)
+            except LaneStopped:
+                pass  # raced stop(); the fallback below still verifies
+        return await asyncio.to_thread(
+            DispatchLane.verify_once, self.backend, self._rng, entries, stages,
+        )

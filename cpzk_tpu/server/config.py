@@ -62,7 +62,21 @@ class TpuSettings:
     batch_max: int = 4096         # dynamic-batcher device batch target
     batch_window_ms: float = 5.0  # queue deadline before dispatch
     mesh_devices: int = 0         # 0 = all visible devices
-    pipeline_depth: int = 2       # in-flight batches (1 = serial dispatch)
+    pipeline_depth: int = 2       # in-flight batches (1 = serial dispatch);
+                                  # >1 double-buffers host prep against
+                                  # device compute on the dispatch lane
+    prewarm_quanta: str = ""      # comma list of batch sizes whose verify
+                                  # kernels are AOT-compiled BEFORE the
+                                  # server reports ready (empty = no
+                                  # prewarm; first dispatch per padded
+                                  # shape pays the XLA trace+compile)
+
+    def parsed_prewarm_quanta(self) -> list[int]:
+        """Batch sizes from the comma-separated config string."""
+        text = self.prewarm_quanta.strip()
+        if not text:
+            return []
+        return [int(part) for part in text.split(",") if part.strip()]
     recovery_after_s: float = 30.0  # breaker cooldown before a TPU probe
                                     # (0 = probe immediately; -1 = never
                                     # self-heal, degrade until /reset)
@@ -309,6 +323,8 @@ class ServerConfig:
             self.tpu.probe_batch_max = int(v)
         if (v := get("TPU_SHED_EXPIRED")) is not None:
             self.tpu.shed_expired = v.lower() in ("1", "true", "yes", "on")
+        if (v := get("TPU_PREWARM_QUANTA")) is not None:
+            self.tpu.prewarm_quanta = v
         if (v := get("RETRY_MAX_ATTEMPTS")) is not None:
             self.retry.max_attempts = int(v)
         if (v := get("RETRY_INITIAL_BACKOFF_MS")) is not None:
@@ -416,6 +432,15 @@ class ServerConfig:
             )
         if self.tpu.probe_batch_max < 1:
             raise ValueError("tpu.probe_batch_max must be positive")
+        try:
+            quanta = self.tpu.parsed_prewarm_quanta()
+        except ValueError:
+            raise ValueError(
+                "tpu.prewarm_quanta must be a comma-separated list of "
+                "batch sizes"
+            ) from None
+        if any(q < 1 for q in quanta):
+            raise ValueError("tpu.prewarm_quanta entries must be positive")
         if self.retry.max_attempts < 1:
             raise ValueError("retry.max_attempts must be >= 1")
         if self.retry.initial_backoff_ms < 0 or self.retry.max_backoff_ms < 0:

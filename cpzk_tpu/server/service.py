@@ -399,15 +399,17 @@ class AuthServiceImpl:
                 error_msgs[i] = "Authentication failed"
                 continue
             live.append((i, user))
-        # Bulk parse: one native validation pass for the whole batch.  On
-        # the inline path the commitment point decodes are deferred to the
-        # batch-verify stage, which decodes them anyway (BatchVerifier
-        # settles failures with the exact parse error); the batcher path
-        # parses eagerly because the shared DynamicBatcher coalesces these
-        # entries with other RPCs' into device batches.
+        # Bulk parse: one native validation pass for the whole batch,
+        # commitment point decodes DEFERRED on every path — the
+        # batch-verify stage decodes them anyway (BatchVerifier settles
+        # failures with the exact parse error).  On the batcher path the
+        # deferred screening runs in BatchVerifier.prepare_batch on the
+        # dispatch lane's prep thread, overlapped with the previous
+        # batch's device compute, so the decode cost leaves the RPC's
+        # serial path entirely.
         parsed = Proof.from_bytes_batch(
             [proof_wires[i] for i, _ in live],
-            defer_point_validation=self.batcher is None,
+            defer_point_validation=True,
         )
         params = Parameters.new()  # shared generators: one instance per RPC
         for (i, user), proof in zip(live, parsed, strict=True):

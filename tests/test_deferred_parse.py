@@ -182,3 +182,56 @@ def test_grpc_batch_reports_exact_parse_error_for_bad_point():
             await server.stop(None)
 
     asyncio.run(flow())
+
+
+def test_grpc_batcher_path_reports_exact_parse_error_for_bad_point():
+    """Same contract THROUGH the batcher -> dispatch lane: proofs defer
+    parsing at the RPC layer, the lane's prep thread settles the decode
+    (BatchVerifier screening / tri-state), and a bad-point item still
+    reports the exact eager-parse message while siblings authenticate."""
+    from cpzk_tpu.protocol.batch import CpuBackend
+    from cpzk_tpu.server.batching import DynamicBatcher
+
+    async def flow():
+        state = ServerState()
+        batcher = DynamicBatcher(CpuBackend(), max_batch=64, window_ms=5.0)
+        server, port = await serve(
+            state, RateLimiter(10_000, 10_000), host="127.0.0.1", port=0,
+            batcher=batcher,
+        )
+        try:
+            rng = SecureRng()
+            async with AuthClient(f"127.0.0.1:{port}") as client:
+                users = []
+                for i in range(3):
+                    prover = Prover(
+                        Parameters.new(), Witness(Ristretto255.random_scalar(rng))
+                    )
+                    resp = await client.register(
+                        f"dpl{i}",
+                        Ristretto255.element_to_bytes(prover.statement.y1),
+                        Ristretto255.element_to_bytes(prover.statement.y2),
+                    )
+                    assert resp.success
+                    users.append((f"dpl{i}", prover))
+
+                ids, cids, proofs = [], [], []
+                for user_id, prover in users:
+                    ch = await client.create_challenge(user_id)
+                    cid = bytes(ch.challenge_id)
+                    t = Transcript()
+                    t.append_context(cid)
+                    proofs.append(prover.prove_with_transcript(rng, t).to_bytes())
+                    ids.append(user_id)
+                    cids.append(cid)
+                proofs[1] = proofs[1][:5] + b"\xff" * 32 + proofs[1][37:]
+
+                resp = await client.verify_proof_batch(ids, cids, proofs)
+                assert [r.success for r in resp.results] == [True, False, True]
+                assert resp.results[1].message == f"Invalid proof: {BAD_POINT_MSG}"
+                assert await state.challenge_count() == 0  # all consumed
+        finally:
+            await batcher.stop()
+            await server.stop(None)
+
+    asyncio.run(flow())

@@ -1,0 +1,510 @@
+"""Dispatch-lane tests (the batcher->backend seam rearchitecture):
+FIFO ordering, leak-free drain-then-join shutdown, chaos containment
+(backend raise mid-batch with and without the failover wrapper), the
+double-buffering proof obligations (near-zero dispatch gap on a
+synthetic slow-host workload, ``device_wait`` staging accounting,
+sub-millisecond ``thread_hop``), AOT prewarm (zero steady-state compile
+spans after warmup), and the ``[tpu] prewarm_quanta`` config knob.
+"""
+
+import asyncio
+import json
+import time
+
+import pytest
+
+from cpzk_tpu import Parameters, Prover, SecureRng, Transcript, Witness
+from cpzk_tpu.core.ristretto import Ristretto255
+from cpzk_tpu.observability import get_flight_recorder
+from cpzk_tpu.ops import backend as backend_mod
+from cpzk_tpu.ops.backend import TpuBackend, prewarm_executables
+from cpzk_tpu.protocol.batch import (
+    BatchEntry,
+    CpuBackend,
+    FailoverBackend,
+    VerifierBackend,
+)
+from cpzk_tpu.server.batching import DynamicBatcher
+from cpzk_tpu.server.dispatch import DispatchLane, LaneStopped
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_recorder():
+    rec = get_flight_recorder()
+    rec.clear()
+    yield
+    rec.clear()
+
+
+def make_entries(n, params=None, rng=None):
+    rng = rng or SecureRng()
+    params = params or Parameters.new()
+    out = []
+    for i in range(n):
+        prover = Prover(params, Witness(Ristretto255.random_scalar(rng)))
+        ctx = b"lane-%04d" % i
+        t = Transcript()
+        t.append_context(ctx)
+        proof = prover.prove_with_transcript(rng, t)
+        out.append(BatchEntry(params, prover.statement, proof, ctx))
+    return out
+
+
+class RecordingBackend(VerifierBackend):
+    """CPU oracle wrapper that logs every backend call's batch size."""
+
+    prefers_combined = False
+
+    def __init__(self, delay_s: float = 0.0):
+        self.sizes: list[int] = []
+        self.delay_s = delay_s
+        self._inner = CpuBackend()
+
+    def verify_combined(self, rows, beta):  # pragma: no cover - unused
+        raise AssertionError("prefers_combined is False")
+
+    def verify_each(self, rows):
+        self.sizes.append(len(rows))
+        if self.delay_s:
+            time.sleep(self.delay_s)
+        return self._inner.verify_each(rows)
+
+
+class ExplodingBackend(VerifierBackend):
+    prefers_combined = False
+
+    def __init__(self, explode_times: int = 10**9):
+        self.calls = 0
+        self.explode_times = explode_times
+
+    def verify_combined(self, rows, beta):  # pragma: no cover - unused
+        raise AssertionError("prefers_combined is False")
+
+    def verify_each(self, rows):
+        self.calls += 1
+        if self.calls <= self.explode_times:
+            raise RuntimeError("injected device loss")
+        return [True] * len(rows)
+
+
+# --- ordering / fairness -----------------------------------------------------
+
+
+def test_lane_executes_batches_fifo():
+    """Submission order IS execution order: the MPSC ingress and the
+    staging buffer are both FIFO, so no batch can overtake another."""
+    backend = RecordingBackend()
+    sizes = [2, 3, 4, 5, 2, 3]
+
+    async def main():
+        lane = DispatchLane(backend, overlap=True)
+        lane.start()
+        futs = [lane.submit(make_entries(k), None) for k in sizes]
+        results = await asyncio.gather(*futs)
+        await lane.stop()
+        return results
+
+    results = run(main())
+    assert [len(r) for r in results] == sizes
+    assert all(all(e is None for e in r) for r in results)
+    assert backend.sizes == sizes  # FIFO, nothing reordered or coalesced
+
+
+def test_lane_serial_mode():
+    """overlap=False (pipeline_depth=1) runs both phases on one
+    persistent thread — same results, strictly serial."""
+    backend = RecordingBackend()
+
+    async def main():
+        lane = DispatchLane(backend, overlap=False)
+        lane.start()
+        futs = [lane.submit(make_entries(2), None) for _ in range(3)]
+        results = await asyncio.gather(*futs)
+        await lane.stop()
+        return results
+
+    results = run(main())
+    assert [len(r) for r in results] == [2, 2, 2]
+    assert backend.sizes == [2, 2, 2]
+
+
+# --- shutdown ----------------------------------------------------------------
+
+
+def test_lane_stop_drains_in_flight_batches():
+    """stop() refuses new work but DRAINS accepted batches: every future
+    resolves with real results, and submit-after-stop raises."""
+    backend = RecordingBackend(delay_s=0.05)
+
+    async def main():
+        lane = DispatchLane(backend, overlap=True)
+        lane.start()
+        futs = [lane.submit(make_entries(2), None) for _ in range(4)]
+        stop_task = asyncio.ensure_future(lane.stop())
+        await asyncio.sleep(0)  # let stop() flip the accepting flag
+        with pytest.raises(LaneStopped):
+            lane.submit(make_entries(1), None)
+        await stop_task
+        assert all(f.done() for f in futs), "stop() returned before drain"
+        return await asyncio.gather(*futs)
+
+    results = run(main())
+    assert len(results) == 4
+    assert all(r == [None, None] for r in results)
+    assert backend.sizes == [2, 2, 2, 2]
+
+
+def test_lane_futures_never_leak_on_cancel():
+    """A cancelled result future (RPC gave up) neither blocks the lane
+    nor errors it: later batches still verify, and stop() stays clean."""
+    backend = RecordingBackend(delay_s=0.02)
+
+    async def main():
+        lane = DispatchLane(backend, overlap=True)
+        lane.start()
+        doomed = lane.submit(make_entries(2), None)
+        live = lane.submit(make_entries(3), None)
+        doomed.cancel()
+        result = await live
+        await lane.stop()
+        return doomed, result
+
+    doomed, result = run(main())
+    assert doomed.cancelled()
+    assert result == [None] * 3
+    assert backend.sizes == [2, 3]  # the cancelled batch still verified
+
+
+def test_batcher_stop_resolves_every_pending_future():
+    """Acceptance: stopping the server with in-flight batches resolves
+    every pending entry future — none left pending, none leaked."""
+    backend = RecordingBackend(delay_s=0.03)
+    entries = make_entries(6)
+
+    async def main():
+        batcher = DynamicBatcher(backend, max_batch=2, window_ms=1.0)
+        batcher.start()
+        pending = [
+            asyncio.ensure_future(batcher.submit_many([e])) for e in entries
+        ]
+        await asyncio.sleep(0.02)  # let some batches commit to the lane
+        await batcher.stop()
+        done = [f.done() for f in pending]
+        results = await asyncio.gather(*pending)
+        return done, results
+
+    done, results = run(main())
+    assert all(done), "batcher.stop() returned with unresolved futures"
+    assert results == [[None]] * 6
+
+
+# --- chaos -------------------------------------------------------------------
+
+
+def test_lane_contains_backend_explosion_to_its_batch():
+    """A backend raise mid-batch resolves THAT batch's future with the
+    exception; the lane threads survive and serve the next batch."""
+    backend = ExplodingBackend(explode_times=1)
+
+    async def main():
+        lane = DispatchLane(backend, overlap=True)
+        lane.start()
+        first = lane.submit(make_entries(2), None)
+        with pytest.raises(RuntimeError, match="injected device loss"):
+            await first
+        second = await lane.submit(make_entries(2), None)
+        await lane.stop()
+        return second
+
+    assert run(main()) == [None, None]
+
+
+def test_lane_failover_breaker_engages_through_lane():
+    """With the failover wrapper, a device loss on the lane's device
+    thread degrades to the CPU fallback mid-stream: results stay
+    correct and the breaker records the trip — the resilience machinery
+    is orthogonal to WHERE the dispatch runs."""
+    broken = ExplodingBackend()
+    backend = FailoverBackend(broken, CpuBackend())
+
+    async def main():
+        batcher = DynamicBatcher(backend, max_batch=8, window_ms=2.0)
+        batcher.start()
+        results = await batcher.submit_many(make_entries(4))
+        await batcher.stop()
+        return results
+
+    assert run(main()) == [None] * 4
+    assert backend.degraded
+    assert broken.calls == 1  # breaker opened on the first loss
+
+
+# --- double-buffering proof obligations --------------------------------------
+
+
+def test_double_buffered_dispatch_gap_near_zero(tmp_path):
+    """Synthetic slow-host workload: device time dominates host prep, so
+    with double-buffering the device thread never idles between batches
+    — steady-state dispatch gap must clamp toward 0 (ISSUE acceptance),
+    and the staged batches book their dwell as ``device_wait``.  Also
+    exercises the ring dump while the lane threads are the writers (the
+    SIGUSR2 path's thread-safety)."""
+    backend = RecordingBackend(delay_s=0.06)  # "device" >> host prep
+
+    async def main():
+        batcher = DynamicBatcher(
+            backend, max_batch=2, window_ms=1.0, pipeline_depth=2
+        )
+        batcher.start()
+        waves = [make_entries(2) for _ in range(4)]
+        results = await asyncio.gather(
+            *[batcher.submit_many(w) for w in waves]
+        )
+        await batcher.stop()
+        return results
+
+    results = run(main())
+    assert all(r == [None, None] for r in results)
+    records = get_flight_recorder().snapshot()
+    assert len(records) == 4
+    steady = records[1:]  # first batch has no predecessor to overlap
+    for rec in steady:
+        # device held ~60ms per batch; an un-overlapped pipeline would
+        # show ~prep-sized gaps — overlap clamps them to scheduler noise
+        assert rec.dispatch_gap_s < 0.03, rec.to_dict()
+    assert any(
+        r.stages_s.get("device_wait", 0.0) > 0.0 for r in steady
+    ), [r.to_dict() for r in records]
+    # the ring dump works while lane threads were the writers
+    path = tmp_path / "ring.json"
+    get_flight_recorder().dump(str(path))
+    assert len(json.loads(path.read_text())["records"]) == 4
+
+
+def test_thread_hop_is_condition_variable_cheap():
+    """The per-batch thread_hop is a cv wakeup on a hot persistent
+    thread, not a thread-pool handoff: sub-millisecond in the common
+    case (asserted loosely at 50ms p50 for CI noise; the real number
+    lands in the perf snapshot's stage percentiles)."""
+    backend = RecordingBackend()
+
+    async def main():
+        batcher = DynamicBatcher(backend, max_batch=4, window_ms=1.0)
+        batcher.start()
+        for _ in range(5):
+            await batcher.submit_many(make_entries(2))
+        await batcher.stop()
+
+    run(main())
+    records = get_flight_recorder().snapshot()
+    hops = sorted(r.stages_s.get("thread_hop", 0.0) for r in records)
+    assert len(hops) == 5
+    assert hops[len(hops) // 2] < 0.05
+    # stage-sum ≈ wall keeps holding with the lane vocabulary
+    for rec in records:
+        # tiny batches leave microsecond slivers between marks; the strict
+        # rel-only form is pinned on >=64-entry batches in test_flightrec
+        assert rec.stage_sum_s() == pytest.approx(
+            rec.wall_s, rel=0.10, abs=2.5e-3
+        ), rec.to_dict()
+
+
+def test_stopped_batcher_inline_path_same_seam():
+    """The stopped-batcher inline verify rides the SAME dispatch seam
+    (DispatchLane.verify_once): the flight record still lands with the
+    full stage decomposition and the stage-sum invariant intact."""
+    backend = RecordingBackend()
+
+    async def main():
+        batcher = DynamicBatcher(backend, max_batch=8, window_ms=1.0)
+        # never started: submit_many falls to the inline seam
+        return await batcher.submit_many(make_entries(3))
+
+    assert run(main()) == [None] * 3
+    records = get_flight_recorder().snapshot()
+    assert len(records) == 1
+    rec = records[0]
+    assert rec.stages_s.get("thread_hop", 0.0) >= 0.0
+    assert rec.stages_s.get("execute", 0.0) > 0.0
+    assert rec.stage_sum_s() == pytest.approx(rec.wall_s, rel=0.10, abs=2.5e-3)
+
+
+# --- AOT prewarm -------------------------------------------------------------
+
+
+def test_prewarm_then_zero_compile_spans(monkeypatch):
+    """ISSUE acceptance: after prewarm, the FIRST serving dispatch at a
+    warmed quantum books jit cache hits only — zero ``compile`` spans,
+    all device time attributed to ``execute``."""
+    monkeypatch.setattr(backend_mod, "_JIT_SEEN", set())
+    monkeypatch.setattr(backend_mod, "_AOT_CACHE", {})
+    warmed = prewarm_executables([6])
+    # combined pads 6+1 -> 8 lanes; the verify_each fallback pads 6 -> 8
+    assert set(warmed) == {"combined/8", "each/8/True"}
+    assert prewarm_executables([6]) == []  # idempotent per shape
+
+    async def main():
+        batcher = DynamicBatcher(TpuBackend(), max_batch=16, window_ms=1.0)
+        batcher.start()
+        results = await batcher.submit_many(make_entries(6))
+        await batcher.stop()
+        return results
+
+    assert run(main()) == [None] * 6
+    records = get_flight_recorder().snapshot()
+    assert len(records) == 1
+    rec = records[0]
+    assert rec.jit_misses == 0, rec.to_dict()
+    assert rec.jit_hits > 0
+    assert rec.stages_s.get("compile", 0.0) == 0.0
+    assert rec.stages_s.get("execute", 0.0) > 0.0
+    assert rec.lanes == 8
+
+
+def test_prewarm_aot_path_is_bit_correct(monkeypatch):
+    """The AOT executable path must agree with the oracle — including
+    the combined-check failure falling back to the (also warmed)
+    verify_each kernel flagging the bad row."""
+    monkeypatch.setattr(backend_mod, "_JIT_SEEN", set())
+    monkeypatch.setattr(backend_mod, "_AOT_CACHE", {})
+    prewarm_executables([5])
+    rng = SecureRng()
+    params = Parameters.new()
+    entries = make_entries(5, params=params, rng=rng)
+    # corrupt one entry: statement/proof mismatch
+    other = make_entries(1, params=params, rng=rng)[0]
+    entries[2] = BatchEntry(
+        params, other.statement, entries[2].proof,
+        entries[2].transcript_context,
+    )
+
+    from cpzk_tpu.protocol.batch import BatchVerifier
+
+    bv = BatchVerifier(backend=TpuBackend(), max_size=8)
+    bv.entries.extend(entries)
+    results = bv.verify(rng)
+    assert [r is None for r in results] == [True, True, False, True, True]
+
+
+# --- config knob -------------------------------------------------------------
+
+
+def test_prewarm_quanta_config_env_and_validation(monkeypatch):
+    from cpzk_tpu.server import ServerConfig
+
+    monkeypatch.setenv("SERVER_TPU_PREWARM_QUANTA", "16, 4096")
+    cfg = ServerConfig()
+    cfg._merge_env()
+    assert cfg.tpu.prewarm_quanta == "16, 4096"
+    assert cfg.tpu.parsed_prewarm_quanta() == [16, 4096]
+    cfg.validate()
+
+    cfg = ServerConfig()
+    cfg.tpu.prewarm_quanta = "banana"
+    with pytest.raises(ValueError, match="prewarm_quanta"):
+        cfg.validate()
+    cfg = ServerConfig()
+    cfg.tpu.prewarm_quanta = "0,16"
+    with pytest.raises(ValueError, match="positive"):
+        cfg.validate()
+    cfg = ServerConfig()
+    cfg.tpu.prewarm_quanta = ""
+    assert cfg.tpu.parsed_prewarm_quanta() == []
+    cfg.validate()
+
+
+# --- buffer donation ---------------------------------------------------------
+
+
+def test_donated_kernels_stay_bit_correct(monkeypatch):
+    """CPZK_DONATE_BUFFERS=1 rebuilds the jitted kernels with donated
+    per-batch inputs; on the XLA CPU backend donation is ignored (with a
+    jax warning) but dispatch must stay bit-correct — the buffer policy
+    can never change accept/reject semantics."""
+    monkeypatch.setenv("CPZK_DONATE_BUFFERS", "1")
+    monkeypatch.setattr(backend_mod, "_KERNELS", {})
+    monkeypatch.setattr(backend_mod, "_JIT_SEEN", set())
+    monkeypatch.setattr(backend_mod, "_AOT_CACHE", {})
+    rng = SecureRng()
+    params = Parameters.new()
+    entries = make_entries(4, params=params, rng=rng)
+    other = make_entries(1, params=params, rng=rng)[0]
+    entries[1] = BatchEntry(
+        params, other.statement, entries[1].proof,
+        entries[1].transcript_context,
+    )
+
+    from cpzk_tpu.protocol.batch import BatchVerifier
+
+    bv = BatchVerifier(backend=TpuBackend(), max_size=8)
+    bv.entries.extend(entries)
+    results = bv.verify(rng)
+    assert [r is None for r in results] == [True, False, True, True]
+
+
+def test_enable_donation_switch(monkeypatch):
+    """The serving-daemon switch flips the policy and rebuilds kernels;
+    env forcing wins over it in both directions."""
+    monkeypatch.setattr(backend_mod, "_KERNELS", {})
+    monkeypatch.setattr(backend_mod, "_DONATE_OVERRIDE", None)
+    monkeypatch.delenv("CPZK_DONATE_BUFFERS", raising=False)
+    assert backend_mod._donation_enabled() is False  # default: off
+    backend_mod.enable_donation(True)
+    assert backend_mod._donation_enabled() is True
+    monkeypatch.setenv("CPZK_DONATE_BUFFERS", "0")
+    assert backend_mod._donation_enabled() is False  # env force wins
+    backend_mod.enable_donation(False)
+    monkeypatch.setenv("CPZK_DONATE_BUFFERS", "1")
+    assert backend_mod._donation_enabled() is True
+
+
+# --- deferred-splice path keeps the full stage decomposition -----------------
+
+
+def test_splice_path_flight_record_tiles_wall(monkeypatch):
+    """A deferred-parse batch with an undecodable wire takes the
+    screen-and-splice path; its flight record must still carry the full
+    stage decomposition (pad_and_pack covers screening + sub prep, the
+    sub-batch's device phase records into the same recorder) and tile
+    the wall — the invariant holds on EVERY path, not just the happy
+    one."""
+    from cpzk_tpu.protocol.gadgets import Proof
+
+    monkeypatch.setattr(backend_mod, "_JIT_SEEN", set())
+    monkeypatch.setattr(backend_mod, "_AOT_CACHE", {})
+    rng = SecureRng()
+    params = Parameters.new()
+    entries = make_entries(6, params=params, rng=rng)
+    # re-parse one proof deferred, then corrupt a commitment point wire
+    wire = entries[2].proof.to_bytes()
+    bad_wire = wire[:5] + b"\xff" * 32 + wire[37:]
+    bad, = Proof.from_bytes_batch([bad_wire], defer_point_validation=True)
+    if not isinstance(bad, Proof):
+        pytest.skip("native frame path absent: bad wire fails eagerly")
+    entries[2] = BatchEntry(
+        params, entries[2].statement, bad, entries[2].transcript_context,
+    )
+
+    async def main():
+        batcher = DynamicBatcher(TpuBackend(), max_batch=16, window_ms=1.0)
+        batcher.start()
+        results = await batcher.submit_many(entries)
+        await batcher.stop()
+        return results
+
+    results = run(main())
+    assert [r is None for r in results] == [
+        True, True, False, True, True, True,
+    ]
+    rec = get_flight_recorder().snapshot()[-1]
+    assert rec.stages_s.get("pad_and_pack", 0.0) > 0.0, rec.to_dict()
+    assert rec.stages_s.get("execute", 0.0) + rec.stages_s.get(
+        "compile", 0.0) > 0.0, rec.to_dict()
+    assert rec.jit_hits + rec.jit_misses > 0, rec.to_dict()
+    assert rec.stage_sum_s() == pytest.approx(
+        rec.wall_s, rel=0.10, abs=2.5e-3
+    ), rec.to_dict()

@@ -346,14 +346,16 @@ def test_multihost_single_process_noop():
     assert mesh.devices.size == len(jax.devices())
 
 
-def test_batcher_pipelines_dispatches():
-    """PP analog (SURVEY §2.3): with pipeline_depth=2 the second batch's
-    dispatch starts while the first is still on the device thread."""
+def test_batcher_overlaps_host_prep_with_device_compute():
+    """Double-buffering through the dispatch lane (PP analog, SURVEY
+    §2.3): while batch 1 is blocked inside the backend on the device
+    thread, batch 2's host prep completes on the prep thread and the
+    prepared batch waits in the lane's staging slot — host work of batch
+    N+1 overlaps device work of batch N instead of queueing behind it."""
     import threading
-    import time as _time
 
     release = threading.Event()
-    starts: list[float] = []
+    entered = threading.Event()
 
     class SlowBackend(VerifierBackend):
         prefers_combined = False
@@ -362,7 +364,7 @@ def test_batcher_pipelines_dispatches():
             raise AssertionError("unused")
 
         def verify_each(self, rows):
-            starts.append(_time.monotonic())
+            entered.set()
             release.wait(5.0)
             return [True] * len(rows)
 
@@ -375,22 +377,22 @@ def test_batcher_pipelines_dispatches():
         batcher.start()
         coros = [batcher.submit(params, st, pr, None) for st, pr in proofs]
         fut = asyncio.gather(*coros)
-        # both dispatches (2 batches of 2) must hit the backend while
-        # neither has completed — i.e. overlap, not serial awaits.  The
-        # assertion happens BEFORE release.set(): under serial dispatch
-        # the first batch blocks in release.wait and the second never
-        # starts, so the poll loop exhausts and we fail here.
-        overlapped = False
+        # The assertion happens BEFORE release.set(): under a serial
+        # (non-overlapping) lane, batch 2 would never be prepared while
+        # batch 1 blocks in the backend, so the poll loop exhausts.
+        staged = False
         for _ in range(200):
-            if len(starts) >= 2:
-                overlapped = True
+            if entered.is_set() and batcher._lane.depths()[1] >= 1:
+                staged = True
                 break
             await asyncio.sleep(0.02)
         release.set()
         results = await fut
         await batcher.stop()
-        return results, overlapped
+        return results, staged
 
-    results, overlapped = run(main())
+    results, staged = run(main())
     assert results == [None] * 4
-    assert overlapped, "second dispatch never started while first was in flight"
+    assert staged, (
+        "batch 2 was never host-prepared while batch 1 held the device thread"
+    )

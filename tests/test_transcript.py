@@ -257,3 +257,33 @@ def test_pinned_transcript_vectors():
                 vec["challenge_scalar"], vec["name"]
             checked += 1
     assert checked == len(data["vectors"]) == 9
+
+
+def test_device_challenges_env_warns_once(monkeypatch):
+    """ADVICE r5 satellite: CPZK_DEVICE_CHALLENGES=1 deployments must be
+    told (once) that the device-challenge path was removed after
+    calibration, instead of silently falling through to the host pool."""
+    import warnings
+
+    import pytest
+
+    from cpzk_tpu.core import transcript as tr
+
+    def derive():
+        w = b"\x01" * 32
+        return tr.derive_challenges_batch([None], [w], [w], [w], [w], [w], [w])
+
+    monkeypatch.setenv("CPZK_DEVICE_CHALLENGES", "1")
+    monkeypatch.setattr(tr, "_DEVICE_CHALLENGES_WARNED", False)
+    with pytest.warns(UserWarning, match="device-challenge"):
+        assert len(derive()) == 1
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")  # a second warning would raise
+        assert len(derive()) == 1
+
+    # unset env: no warning at all
+    monkeypatch.delenv("CPZK_DEVICE_CHALLENGES")
+    monkeypatch.setattr(tr, "_DEVICE_CHALLENGES_WARNED", False)
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        assert len(derive()) == 1
