@@ -48,6 +48,15 @@ class DurabilityManager:
         self.covered_seq = 0
         self._covered_offset = 0
         self._last_snapshot_wall: float | None = None
+        # replication coupling (SegmentShipper | None): compaction is
+        # clamped to the shipped-and-acknowledged offset so a covering
+        # snapshot can never drop records the standby has not received
+        self.shipper = None
+
+    def attach_shipper(self, shipper) -> None:
+        """Couple a primary-side :class:`SegmentShipper` into the
+        compaction path (see ``__init__``)."""
+        self.shipper = shipper
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -82,13 +91,21 @@ class DurabilityManager:
             self._last_snapshot_wall = time.time()
         if self.wal is not None and self.wal.needs_sync():
             await asyncio.to_thread(self.wal.sync)
+        compact_upto = self._covered_offset
+        if self.shipper is not None:
+            # never drop bytes the standby has not acknowledged
+            compact_upto = min(
+                compact_upto, self.shipper.safe_compact_offset()
+            )
         if (
             self.wal is not None
-            and self._covered_offset > 0
+            and compact_upto > 0
             and self.wal.size > self.settings.compact_bytes
         ):
-            freed = await asyncio.to_thread(self.wal.compact, self._covered_offset)
-            self._covered_offset = 0
+            freed = await asyncio.to_thread(self.wal.compact, compact_upto)
+            self._covered_offset -= freed
+            if self.shipper is not None:
+                self.shipper.note_compacted(freed)
             if freed:
                 get_tracer().record_event(
                     "wal_compaction",
@@ -118,7 +135,13 @@ class DurabilityManager:
         # (every journaled mutation also dirties the snapshot flag), so
         # covered_seq == wal.seq here on both branches.
         if self.covered_seq == self.wal.seq and self.wal.size > 0:
-            await asyncio.to_thread(self.wal.compact, self.wal.size)
+            upto = self.wal.size
+            if self.shipper is not None:
+                upto = min(upto, self.shipper.safe_compact_offset())
+            if upto > 0:
+                freed = await asyncio.to_thread(self.wal.compact, upto)
+                if self.shipper is not None:
+                    self.shipper.note_compacted(freed)
             self._covered_offset = 0
         await asyncio.to_thread(self.wal.close)
         self._update_snapshot_age()

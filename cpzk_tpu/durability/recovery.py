@@ -145,8 +145,19 @@ async def recover_state(state, snapshot_path: str, wal_path: str) -> RecoveryRep
             )
 
     # 3. Replay the suffix beyond the snapshot's covered sequence number.
+    #    Challenge records bypass the covered-seq cut: challenges are
+    #    deliberately NOT in the snapshot (300 s single-use nonces — see
+    #    state.py), so their only durable home is the log.  Replaying the
+    #    whole create/consume history is idempotent and cheap (expired
+    #    creates drop, consumes of missing ids skip) and keeps in-flight
+    #    logins alive across a crash that landed between a snapshot and
+    #    the reboot.  Bounded by compaction: records older than the last
+    #    covering compaction are gone, which the 300 s TTL outlives only
+    #    under pathological sweep cadences (docs/operations.md).
     for rec in records:
-        if rec["seq"] <= report.covered_seq:
+        if rec["seq"] <= report.covered_seq and rec.get("type") not in (
+            "create_challenge", "consume_challenge",
+        ):
             continue
         msg = state.replay_journal_record(rec)
         if msg is None:

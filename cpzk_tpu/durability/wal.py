@@ -212,6 +212,29 @@ class WriteAheadLog:
                 raise CrashPoint(f"post_append_pre_fsync at seq {seq}")
             return seq
 
+    def append_frames(self, frames: bytes, last_seq: int) -> None:
+        """Append pre-framed records verbatim, adopting ``last_seq`` as the
+        log head — the replication standby's write path: shipped segments
+        keep the PRIMARY's sequence numbers (replay and a later promotion
+        continue the same numbering), so they must not be re-framed
+        through :meth:`append`.  The caller has already validated the
+        frames (CRC + parse + contiguity); fsync policy applies as usual
+        via :meth:`sync`."""
+        with self._lock:
+            if self._fd is None:
+                raise OSError("write-ahead log is closed")
+            if last_seq <= self.seq:
+                raise ValueError(
+                    f"append_frames would move seq backwards "
+                    f"({last_seq} <= {self.seq})"
+                )
+            os.write(self._fd, frames)
+            self.seq = last_seq
+            self.size += len(frames)
+            self._pending += 1
+            metrics.counter("state.wal.appends").inc()
+            metrics.counter("state.wal.bytes").inc(len(frames))
+
     def needs_sync(self) -> bool:
         """Whether :meth:`sync` would fsync right now under the policy —
         lets the async caller skip the worker-thread hop entirely."""
@@ -294,6 +317,26 @@ class WriteAheadLog:
             self.size = len(tail)
             self._pending = 0  # the tmp copy was fsynced before the rename
             return freed
+
+    def truncate_to(self, valid_bytes: int) -> int:
+        """Drop everything past ``valid_bytes`` (the torn tail a standby
+        found at promotion time); returns bytes dropped.  The log's
+        bookkeeping stays consistent — callers pass the valid-prefix
+        boundary ``iter_frames`` reported."""
+        with self._lock:
+            if self._fd is None:
+                raise OSError("write-ahead log is closed")
+            valid = max(0, min(valid_bytes, self.size))
+            dropped = self.size - valid
+            if dropped:
+                fd = os.open(self.path, os.O_WRONLY)
+                try:
+                    os.ftruncate(fd, valid)
+                    os.fsync(fd)
+                finally:
+                    os.close(fd)
+                self.size = valid
+            return dropped
 
     # -- lifecycle -----------------------------------------------------------
 

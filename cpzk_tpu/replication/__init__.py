@@ -1,0 +1,32 @@
+"""Replicated server state (ISSUE 8): WAL segment shipping to a warm
+standby and lease-based promotion, built on the PR-3 durability subsystem
+and the sharded :class:`~cpzk_tpu.server.state.ServerState`.
+
+- :mod:`.segments` — sealed, CRC-checked WAL slices (the shipping unit);
+- :mod:`.shipper` — primary side: tail-follow the WAL, ship segments,
+  renew the lease, sync-mode acknowledgement barrier, fencing detection;
+- :mod:`.standby` — standby side: validate + replay through the
+  ``replay_journal_record`` trust boundary, lease watch, promotion,
+  epoch fencing;
+- :mod:`.wire` — hand-wired gRPC plumbing for ``proto/replication.proto``.
+
+See ``docs/operations.md`` §"Replication & failover" for the topology,
+the promotion runbook, and the loss-window table.
+"""
+
+from .segments import Segment, seal_segment, split_records, validate_segment
+from .shipper import ReplicationTimeout, SegmentShipper
+from .standby import SegmentApplier, StandbyReplica, load_epoch, store_epoch
+
+__all__ = [
+    "Segment",
+    "seal_segment",
+    "split_records",
+    "validate_segment",
+    "SegmentShipper",
+    "ReplicationTimeout",
+    "SegmentApplier",
+    "StandbyReplica",
+    "load_epoch",
+    "store_epoch",
+]

@@ -1,0 +1,375 @@
+"""Primary-side WAL segment shipping + lease renewal.
+
+A :class:`SegmentShipper` follows the primary's write-ahead log, chops
+new records into sealed segments (plus an unsealed tail-follow of the
+active run), and ships them to the warm standby over the
+ReplicationService.  Every successful exchange renews the standby's view
+of the primary lease; when the primary dies, renewals stop, the lease
+expires, and the standby promotes (``standby.py``).
+
+Modes (``[replication] mode``):
+
+- ``async`` — appends are acknowledged after the local fsync; shipping
+  runs on the renewal cadence (loss window on failover: up to one
+  ``renew_interval_ms`` of acknowledged writes).
+- ``sync``  — :meth:`wait_replicated` is attached to ``ServerState`` as
+  the replication barrier: an acknowledged mutation additionally waits
+  until the standby has applied its sequence number (loss window: none —
+  the SIGKILL chaos test pins it).  If the standby cannot acknowledge
+  within ``sync_timeout_ms`` the mutation FAILS rather than silently
+  degrading to async — zero-loss means refusing to lie about durability.
+
+Fencing: a shipper that sees a higher epoch in a response (or an
+explicit ``fenced`` rejection) has been deposed — it stops shipping for
+good and every sync-mode barrier fails.  Compaction on the primary is
+clamped to the shipped-and-acknowledged byte offset so a covering
+snapshot can never drop records the standby has not yet received.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+
+import grpc
+
+from ..durability.wal import encode_record, iter_frames
+from ..observability import get_tracer
+from ..resilience.faults import CrashPoint
+from ..server import metrics
+from .segments import split_records
+from .standby import load_epoch
+from .wire import ReplicationStub, load_replication_pb2
+
+log = logging.getLogger("cpzk_tpu.replication")
+
+
+class ReplicationTimeout(RuntimeError):
+    """A sync-mode barrier could not confirm standby durability in time
+    (standby down, lagging past ``sync_timeout_ms``, or this primary has
+    been fenced).  The mutation is durable locally but NOT replicated —
+    the caller must surface the failure, not acknowledge the write."""
+
+
+class SegmentShipper:
+    """Ship sealed WAL segments + tail-follow deltas to the standby."""
+
+    def __init__(self, state, manager, settings, faults=None):
+        if manager is None or manager.wal is None:
+            raise ValueError(
+                "SegmentShipper requires a recovered DurabilityManager"
+            )
+        self.state = state
+        self.manager = manager
+        self.settings = settings
+        self._faults = faults
+        self.pb2 = load_replication_pb2()
+        self.epoch_path = settings.epoch_file or manager.state_file + ".epoch"
+        self.epoch = load_epoch(self.epoch_path)
+        self.peer = settings.peer
+        #: byte offset into the WAL file that has been shipped AND
+        #: acknowledged — also the compaction floor (``DurabilityManager``
+        #: never compacts past it)
+        self.acked_offset = 0
+        self.acked_seq = 0
+        self.segments_shipped = 0
+        self.fenced = False
+        self.gap_stalled = False
+        self.crashed: BaseException | None = None
+        self._index = 0
+        self._task: asyncio.Task | None = None
+        self._stop = False
+        self._wake: asyncio.Event | None = None
+        self._ack_cond: asyncio.Condition | None = None
+        self._channel = None
+        self._stub: ReplicationStub | None = None
+        metrics.gauge("state.repl.role").set(1.0)
+        metrics.gauge("state.repl.epoch").set(float(self.epoch))
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> None:
+        """Start the shipping loop (idempotent); call on a running loop."""
+        if self._task is None or self._task.done():
+            self._wake = asyncio.Event()
+            self._ack_cond = asyncio.Condition()
+            self._stop = False
+            self._task = asyncio.get_running_loop().create_task(self._run())
+
+    async def stop(self) -> None:
+        """Graceful stop: one final flush tick, then close the channel."""
+        self._stop = True
+        if self._task is not None:
+            assert self._wake is not None
+            self._wake.set()
+            try:
+                await self._task
+            except Exception:
+                log.exception("segment shipper loop died during stop")
+            self._task = None
+        await self._close_channel()
+
+    async def kill(self) -> None:
+        """Abrupt stop with NO final flush — the in-process stand-in for
+        SIGKILLing the primary (chaos tests)."""
+        self._stop = True
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except (asyncio.CancelledError, Exception):  # noqa: BLE001
+                pass
+            self._task = None
+        await self._close_channel()
+
+    async def _close_channel(self) -> None:
+        if self._channel is not None:
+            await self._channel.close()
+            self._channel = None
+            self._stub = None
+
+    def _ensure_stub(self) -> ReplicationStub:
+        if self._stub is None:
+            self._channel = grpc.aio.insecure_channel(self.peer)
+            self._stub = ReplicationStub(self._channel)
+        return self._stub
+
+    # -- the loop ----------------------------------------------------------
+
+    async def _run(self) -> None:
+        interval = self.settings.renew_interval_ms / 1000.0
+        wake, cond = self._wake, self._ack_cond
+        assert wake is not None and cond is not None
+        final = False
+        while True:
+            if self.fenced or self.crashed is not None:
+                return
+            try:
+                await self._tick()
+            except CrashPoint as e:
+                # a scheduled deterministic death: the primary is "gone"
+                self.crashed = e
+                log.error("segment shipper crash point: %s", e)
+                async with cond:
+                    cond.notify_all()
+                return
+            except grpc.aio.AioRpcError as e:
+                # standby unreachable: keep trying on the cadence — the
+                # lease math on the other side decides what it means
+                log.debug("standby unreachable: %s", e.code())
+            except Exception:
+                log.exception("segment shipper tick failed; retrying")
+            if final:
+                return
+            if self._stop:
+                final = True  # one last flush tick, then exit
+                continue
+            try:
+                await asyncio.wait_for(wake.wait(), timeout=interval)
+            except asyncio.TimeoutError:
+                pass
+            wake.clear()
+
+    async def _tick(self) -> None:
+        """Ship everything new past the acked offset, else renew the lease."""
+        wal = self.manager.wal
+        if wal is None:
+            return
+
+        offset = self.acked_offset
+
+        def _read() -> bytes:
+            with open(wal.path, "rb") as f:
+                f.seek(offset)
+                return f.read()
+
+        raw = await asyncio.to_thread(_read)
+        records, valid = iter_frames(raw)
+        new = [r for r in records if r["seq"] > self.acked_seq]
+        # bytes of already-acknowledged records in the chunk (a restarted
+        # primary re-reading history a caught-up standby already has):
+        # skip them so the compaction floor advances past them too
+        if records and len(new) < len(records):
+            new_bytes = sum(len(encode_record(r)) for r in new)
+            self.acked_offset = offset + valid - new_bytes
+        if not new:
+            await self._renew_lease()
+            return
+        for seg in split_records(
+            new, self.epoch, self._index, self.settings.segment_bytes
+        ):
+            await self._ship(seg)
+            if self.fenced:
+                return
+
+    def _wal_seq(self) -> int:
+        wal = self.manager.wal
+        return wal.seq if wal is not None else 0
+
+    async def _renew_lease(self) -> None:
+        stub = self._ensure_stub()
+        req = self.pb2.ReplicationStatusRequest(
+            epoch=self.epoch, renew_lease=True,
+            primary_seq=self._wal_seq(),
+        )
+        resp = await stub.replication_status(
+            req, timeout=self.settings.sync_timeout_ms / 1000.0
+        )
+        if resp.epoch > self.epoch or resp.role == "primary":
+            self._fence(resp.epoch, "status exchange")
+        else:
+            self.acked_seq = max(self.acked_seq, int(resp.applied_seq))
+        metrics.gauge("state.repl.lag_records").set(
+            float(max(0, self._wal_seq() - self.acked_seq))
+        )
+
+    async def _ship(self, seg) -> None:
+        if self._faults is not None and self._faults.take_crash("pre_ship"):
+            raise CrashPoint(f"pre_ship of segment {seg.index}")
+        stub = self._ensure_stub()
+        frames = seg.frames
+        if self._faults is not None and self._faults.take_crash("mid_segment"):
+            # the death-mid-transfer stand-in: half the frame bytes leave
+            # the machine (CRC intact, so the standby rejects the torn
+            # blob whole), then the "process" dies
+            torn = self.pb2.ShipSegmentRequest(
+                epoch=self.epoch, segment_index=seg.index,
+                first_seq=seg.first_seq, last_seq=seg.last_seq,
+                frames=frames[: max(1, len(frames) // 2)],
+                crc32=seg.crc, sealed=seg.sealed,
+                primary_seq=self._wal_seq(),
+            )
+            try:
+                await stub.ship_segment(
+                    torn, timeout=self.settings.sync_timeout_ms / 1000.0
+                )
+            finally:
+                raise CrashPoint(f"mid_segment of segment {seg.index}")
+        req = self.pb2.ShipSegmentRequest(
+            epoch=self.epoch, segment_index=seg.index,
+            first_seq=seg.first_seq, last_seq=seg.last_seq,
+            frames=frames, crc32=seg.crc, sealed=seg.sealed,
+            primary_seq=self._wal_seq(),
+        )
+        resp = await stub.ship_segment(
+            req, timeout=self.settings.sync_timeout_ms / 1000.0
+        )
+        if resp.accepted:
+            self._index = seg.index + 1
+            self.segments_shipped += 1
+            self.acked_seq = max(self.acked_seq, int(resp.applied_seq))
+            self.acked_offset += len(frames)
+            self.gap_stalled = False
+            metrics.counter("state.repl.segments_shipped").inc()
+            metrics.gauge("state.repl.lag_records").set(
+                float(max(0, self._wal_seq() - self.acked_seq))
+            )
+            await self._notify_ack()
+        elif resp.epoch > self.epoch or "fenced" in resp.message:
+            self._fence(resp.epoch, resp.message)
+            await self._notify_ack()
+        elif "gap" in resp.message:
+            # the standby is missing history this WAL no longer holds
+            # (compacted before the pair was connected): unrecoverable
+            # over the wire — seed the standby from a snapshot copy
+            # (docs/operations.md runbook) — but keep renewing the lease
+            # so a live primary is not failed over from
+            if not self.gap_stalled:
+                log.error(
+                    "standby reports a history gap (%s): seed it from a "
+                    "snapshot copy and restart replication", resp.message,
+                )
+            self.gap_stalled = True
+            await self._renew_lease()
+        else:
+            log.warning("segment %d rejected: %s", seg.index, resp.message)
+
+    async def _notify_ack(self) -> None:
+        cond = self._ack_cond
+        if cond is not None:
+            async with cond:
+                cond.notify_all()
+
+    def _fence(self, their_epoch: int, where: str) -> None:
+        if not self.fenced:
+            log.error(
+                "DEPOSED: standby is at epoch %d > ours %d (%s); this "
+                "primary stops shipping and must not take writes",
+                their_epoch, self.epoch, where,
+            )
+            get_tracer().record_event(
+                "primary_fenced", our_epoch=self.epoch,
+                their_epoch=int(their_epoch),
+            )
+        self.fenced = True
+
+    # -- sync-mode barrier -------------------------------------------------
+
+    async def wait_replicated(self, seq: int) -> None:
+        """Block until the standby has applied ``seq`` (the sync-mode
+        acknowledgement barrier ``ServerState`` awaits before an RPC
+        returns).  Raises :class:`ReplicationTimeout` when the standby
+        cannot confirm within ``sync_timeout_ms`` or this primary has
+        been fenced/crashed."""
+        if seq <= self.acked_seq:
+            return
+        wake, cond = self._wake, self._ack_cond
+        if wake is None or cond is None:
+            raise ReplicationTimeout("segment shipper is not running")
+        wake.set()
+        timeout = self.settings.sync_timeout_ms / 1000.0
+
+        def _done() -> bool:
+            return (
+                self.acked_seq >= seq
+                or self.fenced
+                or self.crashed is not None
+            )
+
+        try:
+            async with cond:
+                await asyncio.wait_for(
+                    cond.wait_for(_done), timeout=timeout
+                )
+        except asyncio.TimeoutError:
+            raise ReplicationTimeout(
+                f"standby did not acknowledge seq {seq} within "
+                f"{self.settings.sync_timeout_ms:g} ms (acked "
+                f"{self.acked_seq})"
+            ) from None
+        if self.fenced:
+            raise ReplicationTimeout(
+                "this primary has been fenced by a promoted standby"
+            )
+        if self.crashed is not None:
+            raise ReplicationTimeout("segment shipper crashed")
+
+    # -- compaction coupling (DurabilityManager) ---------------------------
+
+    def safe_compact_offset(self) -> int:
+        """Compaction floor: bytes at or past this offset have not been
+        acknowledged by the standby and must survive compaction."""
+        return self.acked_offset
+
+    def note_compacted(self, freed: int) -> None:
+        """Compaction dropped ``freed`` bytes of the already-acked prefix;
+        rebase the shipped-offset bookkeeping."""
+        self.acked_offset = max(0, self.acked_offset - freed)
+
+    # -- introspection -----------------------------------------------------
+
+    def status(self) -> dict:
+        """The admin REPL ``/replication`` payload (primary side)."""
+        wal_seq = self._wal_seq()
+        return {
+            "role": "primary",
+            "epoch": self.epoch,
+            "mode": self.settings.mode,
+            "peer": self.peer,
+            "wal_seq": wal_seq,
+            "acked_seq": self.acked_seq,
+            "lag_records": max(0, wal_seq - self.acked_seq),
+            "segments_shipped": self.segments_shipped,
+            "fenced": self.fenced,
+            "gap_stalled": self.gap_stalled,
+        }

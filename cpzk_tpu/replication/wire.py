@@ -1,0 +1,70 @@
+"""gRPC plumbing for the replication plane (hand-wired like auth).
+
+``grpc_tools`` is unavailable in this environment, so the message module
+comes from ``protoc`` via :mod:`cpzk_tpu.server.proto` and the service is
+wired through grpcio's generic handler API on the server side and raw
+``channel.unary_unary`` multicallables on the client side.
+"""
+
+from __future__ import annotations
+
+import grpc
+
+from ..server.proto import load_replication_pb2
+
+SERVICE_NAME = "replication.ReplicationService"
+
+_METHODS = {
+    "ShipSegment": ("ShipSegmentRequest", "ShipSegmentResponse"),
+    "ReplicationStatus": (
+        "ReplicationStatusRequest", "ReplicationStatusResponse",
+    ),
+}
+
+
+def method_types(pb2):
+    """{rpc name: (request class, response class)} for the two RPCs."""
+    return {
+        name: (getattr(pb2, req), getattr(pb2, resp))
+        for name, (req, resp) in _METHODS.items()
+    }
+
+
+def make_replication_handler(impl) -> grpc.GenericRpcHandler:
+    """Generic handler for an object with ``ship_segment`` and
+    ``replication_status`` async methods (the :class:`StandbyReplica`)."""
+    pb2 = load_replication_pb2()
+    types = method_types(pb2)
+    methods = {
+        "ShipSegment": impl.ship_segment,
+        "ReplicationStatus": impl.replication_status,
+    }
+    handlers = {
+        name: grpc.unary_unary_rpc_method_handler(
+            methods[name],
+            request_deserializer=types[name][0].FromString,
+            response_serializer=types[name][1].SerializeToString,
+        )
+        for name in methods
+    }
+    return grpc.method_handlers_generic_handler(SERVICE_NAME, handlers)
+
+
+class ReplicationStub:
+    """Client-side multicallables over an ``grpc.aio`` channel (the
+    shipper's view of the standby)."""
+
+    def __init__(self, channel: grpc.aio.Channel):
+        pb2 = load_replication_pb2()
+        self.pb2 = pb2
+        types = method_types(pb2)
+        self.ship_segment = channel.unary_unary(
+            f"/{SERVICE_NAME}/ShipSegment",
+            request_serializer=types["ShipSegment"][0].SerializeToString,
+            response_deserializer=types["ShipSegment"][1].FromString,
+        )
+        self.replication_status = channel.unary_unary(
+            f"/{SERVICE_NAME}/ReplicationStatus",
+            request_serializer=types["ReplicationStatus"][0].SerializeToString,
+            response_deserializer=types["ReplicationStatus"][1].FromString,
+        )

@@ -36,6 +36,16 @@ import time
 from ..durability.wal import WAL_CRASH_POINTS, CrashPoint  # noqa: F401 (re-export)
 from ..protocol.batch import VerifierBackend
 
+#: Replication-plane crash sites (ISSUE 8): ``pre_ship`` (primary dies
+#: before a segment leaves), ``mid_segment`` (primary dies mid-transfer —
+#: the standby receives a torn segment and must reject it whole), and
+#: ``pre_promote`` (standby dies at the promotion decision; a retried
+#: promote must succeed).  Consulted by ``SegmentShipper`` and
+#: ``StandbyReplica`` the same way the WAL sites are by ``WriteAheadLog``.
+REPLICATION_CRASH_POINTS = ("pre_ship", "mid_segment", "pre_promote")
+
+ALL_CRASH_POINTS = WAL_CRASH_POINTS + REPLICATION_CRASH_POINTS
+
 
 class InjectedFault(RuntimeError):
     """Deterministic injected device failure (stand-in for a TPU loss)."""
@@ -116,13 +126,17 @@ class FaultPlan:
         """Schedule a :class:`CrashPoint` at the ``occurrence``-th visit of
         a WAL crash site (``pre_append`` / ``mid_frame`` /
         ``post_append_pre_fsync`` count once per append, in that order;
-        ``pre_rename`` once per compaction) — the deterministic stand-in
-        for the process dying at exactly that instruction.  Pass the plan
-        as ``WriteAheadLog(..., faults=plan)`` (or via
-        ``DurabilityManager(..., faults=plan)``) to arm it."""
-        if point not in WAL_CRASH_POINTS:
+        ``pre_rename`` once per compaction) or a replication site
+        (``pre_ship`` / ``mid_segment`` once per shipped segment,
+        ``pre_promote`` once per promotion attempt) — the deterministic
+        stand-in for the process dying at exactly that instruction.  Pass
+        the plan as ``WriteAheadLog(..., faults=plan)`` /
+        ``DurabilityManager(..., faults=plan)`` /
+        ``SegmentShipper(..., faults=plan)`` /
+        ``StandbyReplica(..., faults=plan)`` to arm it."""
+        if point not in ALL_CRASH_POINTS:
             raise ValueError(
-                f"unknown crash point {point!r}; one of {WAL_CRASH_POINTS}"
+                f"unknown crash point {point!r}; one of {ALL_CRASH_POINTS}"
             )
         if occurrence < 0:
             raise ValueError("crash_on occurrence must be >= 0")

@@ -132,11 +132,17 @@ async def cleanup_supervisor(
     stop: asyncio.Event,
     state_file: str | None = None,
     durability=None,
+    replica=None,
 ) -> None:
     """Periodic expiry sweeps under a restart-on-crash supervisor
     (server.rs:168-192); with --state-file, each sweep also checkpoints —
     through the :class:`~cpzk_tpu.durability.DurabilityManager` (snapshot
-    + WAL fsync/compaction) when durability is enabled."""
+    + WAL fsync/compaction) when durability is enabled.  An unpromoted
+    replication standby only checkpoints: a local expiry sweep would
+    journal records into the standby's WAL and fork its sequence numbers
+    away from the primary's stream (expired entries are inert anyway —
+    validation rejects them lazily and the primary's own sweep records
+    replay the removals).  Full sweeps resume once promoted."""
 
     async def sweep_loop():
         while not stop.is_set():
@@ -145,10 +151,11 @@ async def cleanup_supervisor(
                 return
             except asyncio.TimeoutError:
                 pass
-            nc = await state.cleanup_expired_challenges()
-            ns = await state.cleanup_expired_sessions()
-            if nc or ns:
-                log.info("cleanup: %d challenges, %d sessions expired", nc, ns)
+            if replica is None or replica.role == "primary":
+                nc = await state.cleanup_expired_challenges()
+                ns = await state.cleanup_expired_sessions()
+                if nc or ns:
+                    log.info("cleanup: %d challenges, %d sessions expired", nc, ns)
             if durability is not None:
                 await durability.checkpoint()
             elif state_file:
@@ -176,6 +183,8 @@ HELP = """Available commands:
                       thread_hop/marshal/compile/execute split, jit hits
   /profile S [DIR]    capture S seconds of jax.profiler (xprof) trace
   /persist     (/wal) durability status: WAL size, fsync age, covered seq
+  /replication (/repl) replication status: role, epoch, lag, lease
+  /promote            promote this standby to primary (operator failover)
   /users       (/u)   registered user count
   /sessions    (/s)   active session count
   /challenges  (/c)   pending challenge count
@@ -187,14 +196,16 @@ HELP = """Available commands:
 
 async def handle_command(
     cmd: str, state: ServerState, backend=None, durability=None,
-    admission=None,
+    admission=None, replication=None,
 ) -> tuple[str, bool]:
     """(output, should_quit) for one REPL line (server.rs:50-90,261-359).
     ``backend`` is the serving FailoverBackend (None on the inline CPU
     path) — /status surfaces its breaker state, /reset re-arms it;
     ``durability`` is the DurabilityManager behind /persist (None when
     durability is disabled); ``admission`` is the AdmissionController
-    behind /overload (None when admission is disabled)."""
+    behind /overload (None when admission is disabled); ``replication``
+    is the SegmentShipper (primary) or StandbyReplica (standby) behind
+    /replication and /promote (None when replication is disabled)."""
     cmd = cmd.strip()
     if not cmd:
         return "", False
@@ -307,6 +318,52 @@ async def handle_command(
             f" snapshot_age={'n/a' if age is None else f'{age:.1f}s'}",
             False,
         )
+    if word in ("/replication", "/repl"):
+        if replication is None:
+            return (
+                "replication disabled (set [replication] enabled = true on "
+                "a durability-enabled pair to get a warm standby)",
+                False,
+            )
+        s = replication.status()
+        if s["role"] == "primary":
+            return (
+                f"role=primary epoch={s['epoch']} mode={s['mode']}"
+                f" peer={s['peer']} wal_seq={s['wal_seq']}"
+                f" acked_seq={s['acked_seq']} lag={s['lag_records']}"
+                f" segments_shipped={s['segments_shipped']}"
+                f" fenced={s['fenced']} gap_stalled={s['gap_stalled']}",
+                False,
+            )
+        lease = s["lease_remaining_s"]
+        return (
+            f"role={s['role']} epoch={s['epoch']}"
+            f" applied_seq={s['applied_seq']} lag={s['lag_records']}"
+            f" segments={s['segments_received']}"
+            f" (rejected={s['segments_rejected']} fenced={s['fenced']})"
+            f" records={s['records_applied']}"
+            f" (skipped={s['records_skipped']})"
+            f" lease={'unarmed' if lease is None else f'{lease:.2f}s'}",
+            False,
+        )
+    if word == "/promote":
+        if replication is None or not hasattr(replication, "promote"):
+            return (
+                "nothing to promote (this node is not a replication "
+                "standby)",
+                False,
+            )
+        report = await replication.promote(reason="operator")
+        if not report["promoted"]:
+            return f"not promoted: {report['message']}", False
+        return (
+            f"PROMOTED to primary: epoch={report['epoch']}"
+            f" applied_seq={report['applied_seq']}"
+            f" tail_replayed={report['replayed_tail']}"
+            f" torn_bytes={report['truncated_bytes']} — this node now "
+            "accepts auth traffic; fence the old primary before reviving it",
+            False,
+        )
     if word in ("/reset", "/rearm"):
         if backend is None or not hasattr(backend, "breaker"):
             return "no failover backend to reset (inline CPU path)", False
@@ -338,7 +395,7 @@ async def load_state(config: ServerConfig):
     it: the plain snapshot restore, where a corrupt snapshot quarantines
     with a loud ERROR and the server boots empty instead of crash-looping
     on every restart."""
-    state = ServerState()
+    state = ServerState(shards=config.replication.shards)
     if config.durability.enabled:
         from ..durability import DurabilityManager
 
@@ -421,10 +478,6 @@ async def amain(args) -> None:
     limiter = config.rate_limit.build_limiter()
     stop = asyncio.Event()
 
-    cleanup_task = asyncio.create_task(
-        cleanup_supervisor(state, stop, config.state_file or None, durability)
-    )
-
     if config.metrics.enabled:
         from . import metrics
 
@@ -460,10 +513,49 @@ async def amain(args) -> None:
             config.admission.per_client_rpm, config.admission.max_clients,
         )
 
+    shipper = None
+    replica = None
+    if config.replication.enabled:
+        from ..replication import SegmentShipper, StandbyReplica
+
+        if config.replication.role == "standby":
+            replica = StandbyReplica(state, durability, config.replication)
+            log.info(
+                "replication standby: epoch=%d applied_seq=%d (auth RPCs "
+                "refused until promotion; lease %gms, auto_promote=%s)",
+                replica.epoch, replica.applied_seq,
+                config.replication.lease_ms, config.replication.auto_promote,
+            )
+        else:
+            shipper = SegmentShipper(state, durability, config.replication)
+            durability.attach_shipper(shipper)
+            if config.replication.mode == "sync":
+                state.attach_replication_barrier(shipper.wait_replicated)
+            log.info(
+                "replication primary: epoch=%d mode=%s -> %s (segment "
+                "%d bytes, renew %gms)",
+                shipper.epoch, config.replication.mode,
+                config.replication.peer, config.replication.segment_bytes,
+                config.replication.renew_interval_ms,
+            )
+
+    # started after the replication block: an unpromoted standby's sweep
+    # must checkpoint-only (see cleanup_supervisor)
+    cleanup_task = asyncio.create_task(
+        cleanup_supervisor(
+            state, stop, config.state_file or None, durability, replica
+        )
+    )
+
     server, port = await serve(
         state, limiter, host=config.host, port=config.port,
         backend=backend, batcher=batcher, tls=tls, admission=admission,
+        replica=replica,
     )
+    if shipper is not None:
+        shipper.start()
+    if replica is not None:
+        replica.start()
     print(_c("green", f"AuthService listening on {config.host}:{port}"))
 
     loop = asyncio.get_running_loop()
@@ -497,7 +589,8 @@ async def amain(args) -> None:
                 stop.set()
                 return
             out, quit_ = await handle_command(
-                line, state, backend, durability, admission
+                line, state, backend, durability, admission,
+                shipper or replica,
             )
             if out:
                 print(_c("white", out))
@@ -520,6 +613,10 @@ async def amain(args) -> None:
     await asyncio.sleep(DRAIN_SECONDS)
     if batcher is not None:
         await batcher.stop()  # drain queued verifications before the listener
+    if shipper is not None:
+        await shipper.stop()  # one final flush tick toward the standby
+    if replica is not None:
+        await replica.stop()
     await server.stop(grace=5)
     cleanup_task.cancel()
     with contextlib.suppress(asyncio.CancelledError):

@@ -132,6 +132,33 @@ class DurabilitySettings:
 
 
 @dataclass
+class ReplicationSettings:
+    """Replicated server state (replication subsystem): WAL segment
+    shipping from the primary to a warm standby, lease-based promotion,
+    and epoch fencing.  Built on [durability] (``enabled`` requires it).
+    See ``docs/operations.md`` §"Replication & failover"."""
+
+    enabled: bool = False
+    role: str = "primary"        # "primary" (ships) | "standby" (receives)
+    peer: str = ""               # primary: the standby's gRPC address
+    mode: str = "async"          # "async" (lose <= renew_interval of acked
+                                 # writes on failover) | "sync" (acks wait
+                                 # for standby apply: zero loss)
+    lease_ms: float = 3000.0     # standby promotes after this long without
+                                 # contact from an equal-or-higher epoch
+    renew_interval_ms: float = 500.0  # ship/renew cadence; MUST be < lease_ms
+    segment_bytes: int = 65536   # seal shipped segments at about this size
+    sync_timeout_ms: float = 1000.0   # sync-mode ack deadline (past it the
+                                      # mutation FAILS, not silently async)
+    auto_promote: bool = True    # standby self-promotes on lease expiry
+                                 # (false = operator /promote only)
+    epoch_file: str = ""         # empty = "<state_file>.epoch"
+    shards: int = 16             # ServerState lock shards; ids/tokens carry
+                                 # the shard tag, so a replicated pair MUST
+                                 # agree on this value (1..256)
+
+
+@dataclass
 class AdmissionSettings:
     """Adaptive overload control (admission subsystem): per-client keyed
     token buckets in an LRU-bounded table, DAGOR-style priority-aware
@@ -201,6 +228,9 @@ class ServerConfig:
         default_factory=ObservabilitySettings
     )
     durability: DurabilitySettings = field(default_factory=DurabilitySettings)
+    replication: ReplicationSettings = field(
+        default_factory=ReplicationSettings
+    )
 
     def addr(self) -> str:
         return f"{self.host}:{self.port}"
@@ -234,6 +264,7 @@ class ServerConfig:
             ("retry", self.retry),
             ("observability", self.observability),
             ("durability", self.durability),
+            ("replication", self.replication),
         ):
             for key, value in data.get(section, {}).items():
                 if hasattr(obj, key):
@@ -361,6 +392,29 @@ class ServerConfig:
             self.durability.fsync_interval_ms = float(v)
         if (v := get("DURABILITY_COMPACT_BYTES")) is not None:
             self.durability.compact_bytes = int(v)
+        # replication knobs (WAL segment shipping + lease-based promotion)
+        if (v := get("REPLICATION_ENABLED")) is not None:
+            self.replication.enabled = v.lower() in ("1", "true", "yes", "on")
+        if (v := get("REPLICATION_ROLE")) is not None:
+            self.replication.role = v.lower()
+        if (v := get("REPLICATION_PEER")) is not None:
+            self.replication.peer = v
+        if (v := get("REPLICATION_MODE")) is not None:
+            self.replication.mode = v.lower()
+        if (v := get("REPLICATION_LEASE_MS")) is not None:
+            self.replication.lease_ms = float(v)
+        if (v := get("REPLICATION_RENEW_INTERVAL_MS")) is not None:
+            self.replication.renew_interval_ms = float(v)
+        if (v := get("REPLICATION_SEGMENT_BYTES")) is not None:
+            self.replication.segment_bytes = int(v)
+        if (v := get("REPLICATION_SYNC_TIMEOUT_MS")) is not None:
+            self.replication.sync_timeout_ms = float(v)
+        if (v := get("REPLICATION_AUTO_PROMOTE")) is not None:
+            self.replication.auto_promote = v.lower() in ("1", "true", "yes", "on")
+        if (v := get("REPLICATION_EPOCH_FILE")) is not None:
+            self.replication.epoch_file = v
+        if (v := get("REPLICATION_SHARDS")) is not None:
+            self.replication.shards = int(v)
 
     # --- validation (config.rs:238-273) ---
 
@@ -477,6 +531,43 @@ class ServerConfig:
                 "durability.enabled requires state_file (the snapshot path "
                 "the write-ahead log is paired with)"
             )
+        if self.replication.role not in ("primary", "standby"):
+            raise ValueError(
+                "replication.role must be 'primary' or 'standby'"
+            )
+        if self.replication.mode not in ("async", "sync"):
+            raise ValueError("replication.mode must be 'async' or 'sync'")
+        if self.replication.renew_interval_ms <= 0:
+            raise ValueError("replication.renew_interval_ms must be positive")
+        # a lease at or below the renewal cadence guarantees spurious
+        # failovers: one delayed renewal and the standby deposes a healthy
+        # primary — reject the configuration outright
+        if self.replication.lease_ms <= self.replication.renew_interval_ms:
+            raise ValueError(
+                "replication.lease_ms must be strictly greater than "
+                "replication.renew_interval_ms (a lease the renewal "
+                "cadence cannot keep alive promotes on every hiccup)"
+            )
+        if self.replication.segment_bytes < 1:
+            raise ValueError("replication.segment_bytes must be positive")
+        if self.replication.sync_timeout_ms <= 0:
+            raise ValueError("replication.sync_timeout_ms must be positive")
+        if not 1 <= self.replication.shards <= 256:
+            raise ValueError(
+                "replication.shards must be in [1, 256] (the shard tag is "
+                "one byte of the challenge id)"
+            )
+        if self.replication.enabled:
+            if not self.durability.enabled:
+                raise ValueError(
+                    "replication.enabled requires durability.enabled (the "
+                    "write-ahead log is what gets shipped)"
+                )
+            if self.replication.role == "primary" and not self.replication.peer:
+                raise ValueError(
+                    "replication on the primary requires peer (the "
+                    "standby's gRPC address)"
+                )
         try:
             buckets = self.observability.parsed_buckets()
         except ValueError:

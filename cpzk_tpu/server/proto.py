@@ -65,6 +65,11 @@ def load_health_pb2():
     return _load("health_pb2", "health.proto")
 
 
+def load_replication_pb2():
+    """The generated ``replication_pb2`` module (WAL segment shipping)."""
+    return _load("replication_pb2", "replication.proto")
+
+
 def method_types(pb2):
     """{rpc name: (request class, response class)} for all five RPCs."""
     return {

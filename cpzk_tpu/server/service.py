@@ -52,12 +52,14 @@ class AuthServiceImpl:
         backend: VerifierBackend | None = None,
         batcher=None,
         admission=None,
+        replica=None,
     ):
         self.state = state
         self.rate_limiter = rate_limiter
         self.backend = backend
         self.batcher = batcher  # DynamicBatcher | None (TPU serving path)
         self.admission = admission  # AdmissionController | None
+        self.replica = replica  # StandbyReplica | None (replication standby)
         self.pb2 = load_pb2()
         self.rng = SecureRng()
         # inline-verify concurrency: 2 lets one RPC's Python overlap
@@ -93,7 +95,14 @@ class AuthServiceImpl:
         """Full admission stack for one RPC: the global token bucket
         (backstop), then the per-client keyed bucket and the adaptive
         priority threshold.  Rejections abort RESOURCE_EXHAUSTED with
-        retry pushback."""
+        retry pushback.  A replication standby that has not been promoted
+        refuses every auth RPC outright — its state is a replica of the
+        primary's, and writes on it would fork history."""
+        if self.replica is not None and self.replica.role != "primary":
+            await context.abort(
+                grpc.StatusCode.UNAVAILABLE,
+                "standby replica: not promoted (writes go to the primary)",
+            )
         try:
             await self.rate_limiter.check_rate_limit()
         except RateLimitExceeded as e:
@@ -260,7 +269,11 @@ class AuthServiceImpl:
                 grpc.StatusCode.NOT_FOUND, f"User '{request.user_id}' not found"
             )
 
-        challenge_id = self.rng.fill_bytes(32)
+        # the id carries the owning user's shard index in byte 0, so
+        # VerifyProof routes straight to the shard that issued it
+        challenge_id = self.state.tag_challenge_id(
+            user.user_id, self.rng.fill_bytes(32)
+        )
         try:
             expires_at = await self.state.create_challenge(user.user_id, challenge_id)
         except errors.Error as e:
@@ -331,7 +344,11 @@ class AuthServiceImpl:
                 grpc.StatusCode.PERMISSION_DENIED, f"Verification failed: {verify_err}"
             )
 
-        token = self.rng.fill_bytes(32).hex()
+        # shard-tagged like the challenge id: validate/revoke route
+        # straight to the issuing shard
+        token = self.state.tag_session_token(
+            request.user_id, self.rng.fill_bytes(32).hex()
+        )
         try:
             await self.state.create_session(token, request.user_id)
         except errors.Error as e:
@@ -474,7 +491,9 @@ class AuthServiceImpl:
                 verified.append(i)
         token_pool = self.rng.fill_bytes(32 * len(verified)).hex()
         for k, i in enumerate(verified):
-            tokens[i] = token_pool[64 * k: 64 * (k + 1)]
+            tokens[i] = self.state.tag_session_token(
+                contexts[i], token_pool[64 * k: 64 * (k + 1)]
+            )
         session_errs = await self.state.create_sessions(
             [(tokens[i], contexts[i]) for i in verified])
         session_err_by_index = dict(zip(verified, session_errs, strict=True))
@@ -564,6 +583,7 @@ async def serve(
     batcher=None,
     tls: tuple[bytes, bytes] | None = None,
     admission=None,
+    replica=None,
 ):
     """Build and start an aio server; returns (server, bound_port).
 
@@ -575,17 +595,28 @@ async def serve(
     daemon can drain it on shutdown.  ``admission`` is an optional
     :class:`~cpzk_tpu.admission.AdmissionController` gating every RPC
     (per-client fairness + priority shedding + retry pushback).
+    ``replica`` is an optional
+    :class:`~cpzk_tpu.replication.StandbyReplica`: its ReplicationService
+    handler is registered alongside the auth service, readiness reports
+    NOT_SERVING until promotion, and every auth RPC aborts UNAVAILABLE
+    while the node is still a standby.
     """
     server = grpc.aio.server()
     service = AuthServiceImpl(
         state, rate_limiter, backend=backend, batcher=batcher,
-        admission=admission,
+        admission=admission, replica=replica,
     )
     server.add_generic_rpc_handlers((make_generic_handler(service),))
+    if replica is not None:
+        server.add_generic_rpc_handlers((replica.handler(),))
     health = _add_health_service(server, backend=backend)
+    if replica is not None:
+        health.standby = replica.role != "primary"
+        replica.health = health  # promotion flips readiness to SERVING
     server.health = health  # for shutdown: server.health.serving = False
     server.batcher = batcher
     server.admission = admission
+    server.replica = replica
     if batcher is not None:
         batcher.start()
     addr = f"{host}:{port}"
@@ -613,9 +644,11 @@ class HealthService:
       liveness — the CPU fallback still answers correctly.
     - ``service="readiness"`` (or the auth service name) — **readiness**:
       additionally NOT_SERVING while WAL recovery/replay is still running
-      (``recovering``) and while the failover breaker holds the backend
-      degraded, so load balancers stop routing to a replica that would
-      only shed or answer at fallback speed, without restart-looping it.
+      (``recovering``), while the failover breaker holds the backend
+      degraded, and while the node is an unpromoted replication standby
+      (``standby`` — lease-based promotion flips it to SERVING), so load
+      balancers stop routing to a replica that would only shed or answer
+      at fallback speed, without restart-looping it.
     """
 
     def __init__(self, backend=None):
@@ -628,10 +661,14 @@ class HealthService:
         #: recovers before binding, where "not ready" is simply
         #: connection-refused).
         self.recovering = False
+        #: True while this node is an unpromoted replication standby —
+        #: liveness stays SERVING (the process is healthy), readiness is
+        #: NOT_SERVING until lease expiry or /promote flips the role.
+        self.standby = False
         self.backend = backend  # FailoverBackend | None
 
     def _ready(self) -> bool:
-        if not self.serving or self.recovering:
+        if not self.serving or self.recovering or self.standby:
             return False
         backend = self.backend
         return not (backend is not None and getattr(backend, "degraded", False))

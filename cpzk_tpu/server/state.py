@@ -5,18 +5,42 @@ with a 2x-age clock-skew guard, session 3600 s), per-user caps (3
 challenges, 5 sessions), global caps (10k users / 50k challenges / 100k
 sessions), consume-once challenge semantics, and cleanup sweeps.
 
-Design deviation (deliberate): ONE ``asyncio.Lock`` guards all five maps.
-The reference takes five ``RwLock``s in inconsistent order between
-``create_challenge`` and ``consume_challenge`` (``state.rs:165-167`` vs
-``:205-206``) — a deadlock hazard under contention flagged in SURVEY.md §5;
-a single lock removes the hazard and is not a throughput bottleneck next to
-group operations.
+Design deviation (deliberate): the registries are split into
+``NUM_STATE_SHARDS`` independently-locked shards keyed by a stable hash
+of the owning ``user_id``.  The reference takes five ``RwLock``s in
+inconsistent order between ``create_challenge`` and ``consume_challenge``
+(``state.rs:165-167`` vs ``:205-206``) — a deadlock hazard under
+contention flagged in SURVEY.md §5; here everything about one user
+(registration, challenges, per-user lists, sessions) lives behind ONE
+shard lock, so no operation ever holds two locks and distinct users stop
+serializing on a single global lock (the per-RPC contention the pre-shard
+design paid — ISSUE 8).
+
+Routing without a scan: challenge ids carry their owning user's shard
+index in byte 0 and session tokens carry it in the first two hex chars
+(stamped by :meth:`ServerState.tag_challenge_id` /
+:meth:`ServerState.tag_session_token` at mint time), so ``VerifyProof``
+and ``validate_session`` land directly on the shard that issued them.
+Untagged ids (tests, snapshots written before sharding) fall back to a
+bounded scan over the shard dicts — correctness never depends on the tag.
+
+Lock discipline (mechanically enforced by cpzk-lint LOCK-001): every
+mutation of a shard's maps happens lexically inside ``async with
+shard.lock`` for that same shard, and every ``_journal_append`` happens
+under the mutating shard's lock — which pins WAL order to in-memory
+application order per shard (cross-shard interleaving on the single
+event loop is itself the application order).  Global capacity caps are
+read as synchronous sums over the shard dicts: the event loop cannot
+interleave another coroutine into a synchronous block, so the check-
+then-insert under one shard lock stays exact.
 """
 
 from __future__ import annotations
 
 import asyncio
 import time
+import zlib
+from collections.abc import MutableMapping
 from dataclasses import dataclass, field
 
 from ..errors import InvalidParams
@@ -32,6 +56,13 @@ MAX_TOTAL_CHALLENGES = 50_000
 MAX_TOTAL_SESSIONS = 100_000
 
 MAX_USER_ID_LEN = 256
+
+#: Default shard count.  Shard indexes are embedded in challenge ids
+#: (byte 0) and session tokens (first two hex chars), so the count is
+#: capped at 256 and must agree across a replicated pair — a promoted
+#: standby routes by tags the primary stamped ([replication] shards).
+NUM_STATE_SHARDS = 16
+MAX_STATE_SHARDS = 256
 
 
 def _valid_user_id_chars(user_id: str) -> bool:
@@ -103,34 +134,208 @@ class SessionData:
         return now >= self.expires_at or age >= 2 * SESSION_EXPIRY_SECONDS
 
 
-class ServerState:
-    """All server registries behind one lock (see module docstring)."""
+class StateShard:
+    """One lock + the five registries it guards, for one hash slice of the
+    user keyspace.  Everything about a user — registration, challenges,
+    sessions, and the per-user index lists — lives in exactly one shard,
+    so no state operation ever needs two locks."""
+
+    __slots__ = (
+        "lock", "_users", "_challenges", "_user_challenges",
+        "_sessions", "_user_sessions",
+    )
 
     def __init__(self) -> None:
-        self._lock = asyncio.Lock()
-        # serializes whole snapshot() calls: overlapping writers (cleanup
-        # sweep vs shutdown) must rename in document-build order, or an
-        # older doc can land over a newer one with _persist_dirty false
-        self._snapshot_lock = asyncio.Lock()
+        self.lock = asyncio.Lock()
         self._users: dict[str, UserData] = {}
         self._challenges: dict[bytes, ChallengeData] = {}
         self._user_challenges: dict[str, list[bytes]] = {}
         self._sessions: dict[str, SessionData] = {}
         self._user_sessions: dict[str, list[str]] = {}
+
+
+class _ShardedView(MutableMapping):
+    """A merged mutable view over one registry across all shards.
+
+    Test/inspection seam only — the RPC paths go straight at the shards.
+    Writes route by the owning user (taken from the key for the
+    user-keyed maps, from the value's ``user_id`` for sessions and
+    challenges); reads try the tag-routed shard first and fall back to a
+    scan, so untagged fixture keys behave exactly as the single-map
+    design did."""
+
+    __slots__ = ("_state", "_attr", "_kind")
+
+    def __init__(self, state: "ServerState", attr: str, kind: str):
+        self._state = state
+        self._attr = attr
+        self._kind = kind  # "user" | "session" | "challenge"
+
+    def _maps(self):
+        return [getattr(s, self._attr) for s in self._state._shards]
+
+    def _map_for_key(self, key):
+        st = self._state
+        if self._kind == "user":
+            return getattr(st._shard_for_user(key), self._attr)
+        if self._kind == "session":
+            idx = st._locate_session(key)
+        else:
+            idx = st._locate_challenge(key)
+        if idx is None:
+            raise KeyError(key)
+        return getattr(st._shards[idx], self._attr)
+
+    def __getitem__(self, key):
+        return self._map_for_key(key)[key]
+
+    def __setitem__(self, key, value) -> None:
+        if self._kind == "user":
+            getattr(self._state._shard_for_user(key), self._attr)[key] = value
+            return
+        owner = getattr(value, "user_id", None)
+        shard = (
+            self._state._shard_for_user(owner)
+            if owner is not None
+            else self._state._shards[0]
+        )
+        getattr(shard, self._attr)[key] = value
+
+    def __delitem__(self, key) -> None:
+        del self._map_for_key(key)[key]
+
+    def __iter__(self):
+        for m in self._maps():
+            yield from m
+
+    def __len__(self) -> int:
+        return sum(len(m) for m in self._maps())
+
+    def __contains__(self, key) -> bool:
+        try:
+            self._map_for_key(key)
+        except KeyError:
+            return False
+        return True
+
+
+class ServerState:
+    """All server registries behind per-shard locks (see module docstring)."""
+
+    def __init__(self, shards: int = NUM_STATE_SHARDS) -> None:
+        if not 1 <= shards <= MAX_STATE_SHARDS:
+            raise ValueError(
+                f"shards must be in [1, {MAX_STATE_SHARDS}], got {shards}"
+            )
+        self.num_shards = shards
+        self._shards = [StateShard() for _ in range(shards)]
+        # serializes whole snapshot() calls: overlapping writers (cleanup
+        # sweep vs shutdown) must rename in document-build order, or an
+        # older doc can land over a newer one with _persist_dirty false
+        self._snapshot_lock = asyncio.Lock()
         # set on any change to persisted data (users/sessions); lets the
         # periodic snapshot skip writes on an idle server
         self._persist_dirty = True
         # durability journal hook (WriteAheadLog | None): when attached,
         # every acknowledged mutation to persisted data is appended —
-        # under the state lock, so WAL order always equals application
-        # order — and fsynced (per policy) before the RPC returns
+        # under the mutating shard's lock, so WAL order always equals
+        # application order — and fsynced (per policy) before the RPC
+        # returns
         self.journal = None
+        # synchronous-replication barrier (async callable(seq) | None):
+        # when attached by a sync-mode SegmentShipper, acknowledged
+        # mutations additionally wait until the warm standby has applied
+        # the journal up to their sequence number (zero-loss failover)
+        self.repl_barrier = None
         # WAL sequence number the last-restored snapshot covered
         self.restored_wal_seq = 0
         # (seq, byte offset) of the journal at the last snapshot write:
         # the compaction watermark — everything before it is covered
         self.snapshot_covered_seq = 0
         self.snapshot_covered_offset = 0
+
+    # --- shard routing ----------------------------------------------------
+
+    def _shard_index(self, user_id: str) -> int:
+        """Stable user->shard hash (crc32: identical across processes, so
+        a promoted standby routes the tags the primary stamped)."""
+        return zlib.crc32(user_id.encode()) % self.num_shards
+
+    def _shard_for_user(self, user_id: str) -> StateShard:
+        return self._shards[self._shard_index(user_id)]
+
+    def tag_challenge_id(self, user_id: str, challenge_id: bytes) -> bytes:
+        """Stamp the owning user's shard index into byte 0 of a freshly
+        minted challenge id, so ``consume_challenge`` lands on the issuing
+        shard without a scan (31 of the 32 random bytes remain)."""
+        return bytes([self._shard_index(user_id)]) + challenge_id[1:]
+
+    def tag_session_token(self, user_id: str, token: str) -> str:
+        """Stamp the owning user's shard index into the first two hex
+        chars of a freshly minted session token (same routing contract as
+        :meth:`tag_challenge_id`)."""
+        return f"{self._shard_index(user_id):02x}" + token[2:]
+
+    def _locate_challenge(self, challenge_id: bytes) -> int | None:
+        """Shard index holding ``challenge_id``: the tag byte when it
+        routes to a hit, else a bounded scan (untagged test/legacy ids);
+        ``None`` when no shard holds it.  Synchronous — callers re-check
+        under the shard lock before mutating."""
+        if challenge_id:
+            idx = challenge_id[0]
+            if idx < self.num_shards and challenge_id in self._shards[idx]._challenges:
+                return idx
+        for i, shard in enumerate(self._shards):
+            if challenge_id in shard._challenges:
+                return i
+        return None
+
+    def _locate_session(self, token: str) -> int | None:
+        """Shard index holding ``token`` (tag-routed, scan fallback)."""
+        if len(token) >= 2:
+            try:
+                idx = int(token[:2], 16)
+            except ValueError:
+                idx = -1
+            if 0 <= idx < self.num_shards and token in self._shards[idx]._sessions:
+                return idx
+        for i, shard in enumerate(self._shards):
+            if token in shard._sessions:
+                return i
+        return None
+
+    # --- global counts (synchronous: exact on the event loop) -------------
+
+    def _total_users(self) -> int:
+        return sum(len(s._users) for s in self._shards)
+
+    def _total_challenges(self) -> int:
+        return sum(len(s._challenges) for s in self._shards)
+
+    def _total_sessions(self) -> int:
+        return sum(len(s._sessions) for s in self._shards)
+
+    # --- merged views (test/inspection seam; RPC paths use shards) --------
+
+    @property
+    def _users(self) -> _ShardedView:
+        return _ShardedView(self, "_users", "user")
+
+    @property
+    def _sessions(self) -> _ShardedView:
+        return _ShardedView(self, "_sessions", "session")
+
+    @property
+    def _challenges(self) -> _ShardedView:
+        return _ShardedView(self, "_challenges", "challenge")
+
+    @property
+    def _user_sessions(self) -> _ShardedView:
+        return _ShardedView(self, "_user_sessions", "user")
+
+    @property
+    def _user_challenges(self) -> _ShardedView:
+        return _ShardedView(self, "_user_challenges", "user")
 
     # --- durability journal (cpzk_tpu/durability/) ---
 
@@ -139,30 +344,43 @@ class ServerState:
         once by ``DurabilityManager.recover`` before serving starts)."""
         self.journal = wal
 
-    # cpzk-lint: disable=LOCK-001 -- append funnel: every caller holds self._lock (docstring contract)
+    def attach_replication_barrier(self, barrier) -> None:
+        """Install a sync-replication barrier: an async callable awaited
+        with the journal's sequence number after fsync and before the
+        mutation is acknowledged (``SegmentShipper.wait_replicated`` in
+        ``mode = "sync"``)."""
+        self.repl_barrier = barrier
+
+    # cpzk-lint: disable=LOCK-001 -- append funnel: every caller holds the mutating shard's lock (docstring contract)
     def _journal_append(self, rtype: str, payload: dict) -> None:
-        """Append one record — callers hold ``self._lock``, which pins WAL
-        order to in-memory application order."""
+        """Append one record — callers hold the mutating shard's ``lock``,
+        which pins WAL order to in-memory application order."""
         if self.journal is not None:
             self.journal.append(rtype, payload)
 
     async def _journal_sync(self) -> None:
         """Make appended records durable per the WAL's fsync policy; called
-        AFTER the state lock is released (fsync flushes every earlier
+        AFTER the shard lock is released (fsync flushes every earlier
         append too, so interleaved mutations stay individually durable)
-        and BEFORE the mutation is acknowledged to the caller."""
+        and BEFORE the mutation is acknowledged to the caller.  With a
+        sync-replication barrier attached, the acknowledgement further
+        waits for the warm standby to apply up to this sequence number."""
         wal = self.journal
         if wal is not None and wal.needs_sync():
             await asyncio.to_thread(wal.sync)
+        barrier = self.repl_barrier
+        if barrier is not None and wal is not None:
+            await barrier(wal.seq)
 
     # cpzk-lint: disable=LOCK-001 -- boot-time replay runs single-threaded before serving starts
     def replay_journal_record(self, rec: dict) -> str | None:
-        """Boot-time replay of one WAL record through the same
-        trust-boundary validators as :meth:`restore` — a tampered log
-        cannot smuggle in what the live RPC would reject.  Single-threaded
-        (recovery runs before serving starts), so no lock.  Returns None
-        when applied, else the skip reason; never raises on malformed
-        input (the fuzz harness holds this as an invariant)."""
+        """Boot-time (and standby-side) replay of one WAL record through
+        the same trust-boundary validators as :meth:`restore` — a tampered
+        log cannot smuggle in what the live RPC would reject.
+        Single-threaded (recovery runs before serving starts; the standby
+        applies segments before it is promoted to serve), so no lock.
+        Returns None when applied, else the skip reason; never raises on
+        malformed input (the fuzz harness holds this as an invariant)."""
         from ..core.ristretto import Ristretto255
 
         try:
@@ -172,15 +390,16 @@ class ServerState:
                 msg = user_id_error(uid)
                 if msg is not None:
                     return msg
-                if uid in self._users:
+                shard = self._shard_for_user(uid)
+                if uid in shard._users:
                     return "already registered"
-                if len(self._users) >= MAX_TOTAL_USERS:
+                if self._total_users() >= MAX_TOTAL_USERS:
                     return "user capacity cap"
                 y1 = Ristretto255.element_from_bytes(bytes.fromhex(rec["y1"]))
                 y2 = Ristretto255.element_from_bytes(bytes.fromhex(rec["y2"]))
                 if Ristretto255.is_identity(y1) or Ristretto255.is_identity(y2):
                     return "identity statement element"
-                self._users[uid] = UserData(
+                shard._users[uid] = UserData(
                     user_id=uid,
                     statement=Statement(y1, y2),
                     registered_at=int(rec["registered_at"]),
@@ -192,43 +411,86 @@ class ServerState:
                 created, expires = int(rec["created_at"]), int(rec["expires_at"])
                 if expires <= created or expires - created > SESSION_EXPIRY_SECONDS:
                     return "invalid session expiry"
-                if uid not in self._users:
+                shard = self._shard_for_user(uid)
+                if uid not in shard._users:
                     return "unregistered user"
-                if token in self._sessions:
+                if self._locate_session(token) is not None:
                     return "duplicate session token"
-                if len(self._sessions) >= MAX_TOTAL_SESSIONS:
+                if self._total_sessions() >= MAX_TOTAL_SESSIONS:
                     return "session capacity cap"
                 data = SessionData(
                     token=token, user_id=uid, created_at=created, expires_at=expires
                 )
                 if data.is_expired():
                     return None  # same silent drop as restore()
-                per_user = self._user_sessions.setdefault(uid, [])
+                per_user = shard._user_sessions.setdefault(uid, [])
                 if len(per_user) >= MAX_SESSIONS_PER_USER:
                     return "per-user session cap"
-                self._sessions[token] = data
+                shard._sessions[token] = data
                 per_user.append(token)
                 self._persist_dirty = True
                 return None
             if rtype == "revoke_session":
-                data = self._sessions.pop(str(rec["token"]), None)
-                if data is None:
+                token = str(rec["token"])
+                idx = self._locate_session(token)
+                if idx is None:
                     return "session not found"
-                per_user = self._user_sessions.get(data.user_id)
+                shard = self._shards[idx]
+                data = shard._sessions.pop(token)
+                per_user = shard._user_sessions.get(data.user_id)
                 if per_user is not None and data.token in per_user:
                     per_user.remove(data.token)
                 self._persist_dirty = True
                 return None
             if rtype == "expire_sessions":
                 now = int(rec["now"])
-                for t in [
-                    t for t, d in self._sessions.items() if d.is_expired(now)
-                ]:
-                    data = self._sessions.pop(t)
-                    per_user = self._user_sessions.get(data.user_id)
-                    if per_user is not None and t in per_user:
-                        per_user.remove(t)
+                for shard in self._shards:
+                    for t in [
+                        t for t, d in shard._sessions.items() if d.is_expired(now)
+                    ]:
+                        data = shard._sessions.pop(t)
+                        per_user = shard._user_sessions.get(data.user_id)
+                        if per_user is not None and t in per_user:
+                            per_user.remove(t)
                 self._persist_dirty = True
+                return None
+            if rtype == "create_challenge":
+                cid, uid = bytes.fromhex(rec["challenge_id"]), str(rec["user_id"])
+                created, expires = int(rec["created_at"]), int(rec["expires_at"])
+                if (
+                    expires <= created
+                    or expires - created > CHALLENGE_EXPIRY_SECONDS
+                ):
+                    return "invalid challenge expiry"
+                shard = self._shard_for_user(uid)
+                if uid not in shard._users:
+                    return "unregistered user"
+                if self._locate_challenge(cid) is not None:
+                    return "duplicate challenge id"
+                if self._total_challenges() >= MAX_TOTAL_CHALLENGES:
+                    return "challenge capacity cap"
+                data = ChallengeData(
+                    challenge_id=cid, user_id=uid,
+                    created_at=created, expires_at=expires,
+                )
+                if data.is_expired():
+                    return None  # stale in-flight login: drop silently
+                per_user = shard._user_challenges.setdefault(uid, [])
+                if len(per_user) >= MAX_CHALLENGES_PER_USER:
+                    return "per-user challenge cap"
+                shard._challenges[cid] = data
+                per_user.append(cid)
+                return None
+            if rtype == "consume_challenge":
+                cid = bytes.fromhex(rec["challenge_id"])
+                idx = self._locate_challenge(cid)
+                if idx is None:
+                    return "challenge not found"
+                shard = self._shards[idx]
+                data = shard._challenges.pop(cid)
+                per_user = shard._user_challenges.get(data.user_id)
+                if per_user is not None and cid in per_user:
+                    per_user.remove(cid)
                 return None
             return f"unknown record type {rtype!r}"
         except Exception as e:  # malformed fields are a rejection, not a crash
@@ -237,14 +499,15 @@ class ServerState:
     # --- users (state.rs:136-161) ---
 
     async def register_user(self, user_data: UserData) -> None:
-        async with self._lock:
-            if len(self._users) >= MAX_TOTAL_USERS:
+        shard = self._shard_for_user(user_data.user_id)
+        async with shard.lock:
+            if self._total_users() >= MAX_TOTAL_USERS:
                 raise InvalidParams(
                     f"Server has reached maximum user capacity ({MAX_TOTAL_USERS})"
                 )
-            if user_data.user_id in self._users:
+            if user_data.user_id in shard._users:
                 raise InvalidParams(f"User '{user_data.user_id}' already registered")
-            self._users[user_data.user_id] = user_data
+            shard._users[user_data.user_id] = user_data
             self._persist_dirty = True
             if self.journal is not None:
                 from ..core.ristretto import Ristretto255
@@ -265,30 +528,56 @@ class ServerState:
         return (await self.get_users([user_id]))[0]
 
     async def get_users(self, user_ids: list[str]) -> list[UserData | None]:
-        async with self._lock:
-            return [self._users.get(u) for u in user_ids]
+        out: dict[int, UserData | None] = {}
+        by_shard: dict[int, list[tuple[int, str]]] = {}
+        for i, uid in enumerate(user_ids):
+            by_shard.setdefault(self._shard_index(uid), []).append((i, uid))
+        for idx in sorted(by_shard):
+            shard = self._shards[idx]
+            async with shard.lock:
+                for i, uid in by_shard[idx]:
+                    out[i] = shard._users.get(uid)
+        return [out[i] for i in range(len(user_ids))]
 
     # --- challenges (state.rs:164-249) ---
 
     async def create_challenge(self, user_id: str, challenge_id: bytes) -> int:
-        async with self._lock:
-            if len(self._challenges) >= MAX_TOTAL_CHALLENGES:
+        shard = self._shard_for_user(user_id)
+        async with shard.lock:
+            if self._total_challenges() >= MAX_TOTAL_CHALLENGES:
                 raise InvalidParams(
                     f"Server has reached maximum challenge capacity ({MAX_TOTAL_CHALLENGES})"
                 )
-            if user_id not in self._users:
+            if user_id not in shard._users:
                 raise InvalidParams(f"User '{user_id}' not found")
-            per_user = self._user_challenges.setdefault(user_id, [])
+            per_user = shard._user_challenges.setdefault(user_id, [])
             if len(per_user) >= MAX_CHALLENGES_PER_USER:
                 raise InvalidParams(f"Too many active challenges for user '{user_id}'")
             data = ChallengeData(challenge_id=challenge_id, user_id=user_id)
             per_user.append(challenge_id)
-            self._challenges[challenge_id] = data
-            return data.expires_at
+            shard._challenges[challenge_id] = data
+            # journaled so a crash-reboot (and a promoted standby) does not
+            # strand every in-flight login (ISSUE 8 satellite) — replayed
+            # through the same validators as the other record types
+            self._journal_append(
+                "create_challenge",
+                {
+                    "challenge_id": challenge_id.hex(),
+                    "user_id": user_id,
+                    "created_at": data.created_at,
+                    "expires_at": data.expires_at,
+                },
+            )
+        await self._journal_sync()
+        return data.expires_at
 
     async def get_challenge(self, challenge_id: bytes) -> ChallengeData | None:
-        async with self._lock:
-            return self._challenges.get(challenge_id)
+        idx = self._locate_challenge(challenge_id)
+        if idx is None:
+            return None
+        shard = self._shards[idx]
+        async with shard.lock:
+            return shard._challenges.get(challenge_id)
 
     async def consume_challenge(self, challenge_id: bytes) -> ChallengeData:
         """Single-use removal; expired challenges are removed AND rejected.
@@ -299,35 +588,61 @@ class ServerState:
         return data
 
     async def consume_challenges(self, ids: list[bytes]) -> list[ChallengeData | None]:
-        """Bulk consume-once under ONE lock acquisition (the batch RPC's
-        hot path: n sequential ``consume_challenge`` awaits cost n event-
-        loop round-trips).  Per-id semantics identical to
+        """Bulk consume-once, one lock acquisition per touched shard (the
+        batch RPC's hot path: n sequential ``consume_challenge`` awaits
+        cost n event-loop round-trips).  Per-id semantics identical to
         :meth:`consume_challenge`, with ``None`` standing in for the
         invalid/expired rejection; duplicate ids in one batch behave as
-        they would sequentially (first wins)."""
-        async with self._lock:
-            out: list[ChallengeData | None] = []
-            for cid in ids:
-                data = self._challenges.get(cid)
-                if data is None:
-                    out.append(None)
-                    continue
-                del self._challenges[cid]
-                per_user = self._user_challenges.get(data.user_id)
-                if per_user is not None and cid in per_user:
-                    per_user.remove(cid)
-                out.append(None if data.is_expired() else data)
-            return out
+        they would sequentially (first wins — duplicates always route to
+        the same shard)."""
+        out: dict[int, ChallengeData | None] = {}
+        by_shard: dict[int, list[tuple[int, bytes]]] = {}
+        for i, cid in enumerate(ids):
+            idx = self._locate_challenge(cid)
+            if idx is None:
+                out[i] = None
+            else:
+                by_shard.setdefault(idx, []).append((i, cid))
+        journaled = False
+        for idx in sorted(by_shard):
+            shard = self._shards[idx]
+            async with shard.lock:
+                for i, cid in by_shard[idx]:
+                    # re-check under the lock: located synchronously above,
+                    # and a duplicate earlier in this batch may have won
+                    data = shard._challenges.get(cid)
+                    if data is None:
+                        out[i] = None
+                        continue
+                    del shard._challenges[cid]
+                    per_user = shard._user_challenges.get(data.user_id)
+                    if per_user is not None and cid in per_user:
+                        per_user.remove(cid)
+                    self._journal_append(
+                        "consume_challenge", {"challenge_id": cid.hex()}
+                    )
+                    journaled = True
+                    out[i] = None if data.is_expired() else data
+        if journaled:
+            await self._journal_sync()
+        return [out[i] for i in range(len(ids))]
 
     async def cleanup_expired_challenges(self) -> int:
-        async with self._lock:
-            expired = [cid for cid, d in self._challenges.items() if d.is_expired()]
-            for cid in expired:
-                data = self._challenges.pop(cid)
-                per_user = self._user_challenges.get(data.user_id)
-                if per_user is not None and cid in per_user:
-                    per_user.remove(cid)
-            return len(expired)
+        removed = 0
+        for shard in self._shards:
+            async with shard.lock:
+                expired = [
+                    cid for cid, d in shard._challenges.items() if d.is_expired()
+                ]
+                for cid in expired:
+                    data = shard._challenges.pop(cid)
+                    per_user = shard._user_challenges.get(data.user_id)
+                    if per_user is not None and cid in per_user:
+                        per_user.remove(cid)
+                removed += len(expired)
+        # no journal record: expiry is deterministic from the timestamps a
+        # create_challenge record carries, so replay drops them on its own
+        return removed
 
     # --- sessions (state.rs:252-327) ---
 
@@ -338,44 +653,60 @@ class ServerState:
             raise InvalidParams(msg)
 
     async def create_sessions(self, pairs: list[tuple[str, str]]) -> list[str | None]:
-        """Bulk session mint under ONE lock: per-(token, user_id) result is
-        ``None`` on success or the same error message
-        :meth:`create_session` would raise, applied in order (so caps are
-        enforced exactly as a sequential loop would)."""
-        async with self._lock:
-            out: list[str | None] = []
-            for token, user_id in pairs:
-                if len(self._sessions) >= MAX_TOTAL_SESSIONS:
-                    out.append(
-                        f"Server has reached maximum session capacity ({MAX_TOTAL_SESSIONS})"
+        """Bulk session mint, one lock acquisition per touched shard:
+        per-(token, user_id) result is ``None`` on success or the same
+        error message :meth:`create_session` would raise.  Caps are
+        enforced exactly as a sequential loop would within each shard;
+        across shards the loop runs in shard-index order (the global cap
+        stays exact — counts are synchronous sums)."""
+        out: dict[int, str | None] = {}
+        by_shard: dict[int, list[tuple[int, str, str]]] = {}
+        for i, (token, user_id) in enumerate(pairs):
+            by_shard.setdefault(self._shard_index(user_id), []).append(
+                (i, token, user_id)
+            )
+        journaled = False
+        for idx in sorted(by_shard):
+            shard = self._shards[idx]
+            async with shard.lock:
+                for i, token, user_id in by_shard[idx]:
+                    if self._total_sessions() >= MAX_TOTAL_SESSIONS:
+                        out[i] = (
+                            f"Server has reached maximum session capacity ({MAX_TOTAL_SESSIONS})"
+                        )
+                        continue
+                    per_user = shard._user_sessions.setdefault(user_id, [])
+                    if len(per_user) >= MAX_SESSIONS_PER_USER:
+                        out[i] = (
+                            f"User '{user_id}' has reached maximum session limit ({MAX_SESSIONS_PER_USER})"
+                        )
+                        continue
+                    data = SessionData(token=token, user_id=user_id)
+                    shard._sessions[token] = data
+                    per_user.append(token)
+                    self._persist_dirty = True
+                    self._journal_append(
+                        "create_session",
+                        {
+                            "token": data.token,
+                            "user_id": data.user_id,
+                            "created_at": data.created_at,
+                            "expires_at": data.expires_at,
+                        },
                     )
-                    continue
-                per_user = self._user_sessions.setdefault(user_id, [])
-                if len(per_user) >= MAX_SESSIONS_PER_USER:
-                    out.append(
-                        f"User '{user_id}' has reached maximum session limit ({MAX_SESSIONS_PER_USER})"
-                    )
-                    continue
-                data = SessionData(token=token, user_id=user_id)
-                self._sessions[token] = data
-                per_user.append(token)
-                self._persist_dirty = True
-                self._journal_append(
-                    "create_session",
-                    {
-                        "token": data.token,
-                        "user_id": data.user_id,
-                        "created_at": data.created_at,
-                        "expires_at": data.expires_at,
-                    },
-                )
-                out.append(None)
-        await self._journal_sync()
-        return out
+                    journaled = True
+                    out[i] = None
+        if journaled:
+            await self._journal_sync()
+        return [out[i] for i in range(len(pairs))]
 
     async def validate_session(self, token: str) -> str:
-        async with self._lock:
-            data = self._sessions.get(token)
+        idx = self._locate_session(token)
+        if idx is None:
+            raise InvalidParams("Invalid session token")
+        shard = self._shards[idx]
+        async with shard.lock:
+            data = shard._sessions.get(token)
             if data is None:
                 raise InvalidParams("Invalid session token")
             if data.is_expired():
@@ -383,11 +714,15 @@ class ServerState:
             return data.user_id
 
     async def revoke_session(self, token: str) -> None:
-        async with self._lock:
-            data = self._sessions.pop(token, None)
+        idx = self._locate_session(token)
+        if idx is None:
+            raise InvalidParams("Session not found")
+        shard = self._shards[idx]
+        async with shard.lock:
+            data = shard._sessions.pop(token, None)
             if data is None:
                 raise InvalidParams("Session not found")
-            per_user = self._user_sessions.get(data.user_id)
+            per_user = shard._user_sessions.get(data.user_id)
             if per_user is not None and token in per_user:
                 per_user.remove(token)
             self._persist_dirty = True
@@ -395,35 +730,42 @@ class ServerState:
         await self._journal_sync()
 
     async def cleanup_expired_sessions(self) -> int:
-        async with self._lock:
-            # one timestamp for the whole sweep, so the journaled record
-            # replays to exactly the set of sessions removed here
-            now = _now()
-            expired = [t for t, d in self._sessions.items() if d.is_expired(now)]
-            for t in expired:
-                data = self._sessions.pop(t)
-                per_user = self._user_sessions.get(data.user_id)
-                if per_user is not None and t in per_user:
-                    per_user.remove(t)
-            if expired:
-                self._persist_dirty = True
-                self._journal_append("expire_sessions", {"now": now})
-        await self._journal_sync()
-        return len(expired)
+        removed = 0
+        # one timestamp for the whole sweep, so the journaled records
+        # replay to exactly the set of sessions removed here
+        now = _now()
+        journaled = False
+        for shard in self._shards:
+            async with shard.lock:
+                expired = [
+                    t for t, d in shard._sessions.items() if d.is_expired(now)
+                ]
+                for t in expired:
+                    data = shard._sessions.pop(t)
+                    per_user = shard._user_sessions.get(data.user_id)
+                    if per_user is not None and t in per_user:
+                        per_user.remove(t)
+                if expired:
+                    self._persist_dirty = True
+                    # one record per shard that expired something: replay
+                    # applies the sweep globally, so repeats are no-ops
+                    self._journal_append("expire_sessions", {"now": now})
+                    journaled = True
+                removed += len(expired)
+        if journaled:
+            await self._journal_sync()
+        return removed
 
     # --- counts (state.rs:330-342) ---
 
     async def user_count(self) -> int:
-        async with self._lock:
-            return len(self._users)
+        return self._total_users()
 
     async def session_count(self) -> int:
-        async with self._lock:
-            return len(self._sessions)
+        return self._total_sessions()
 
     async def challenge_count(self) -> int:
-        async with self._lock:
-            return len(self._challenges)
+        return self._total_challenges()
 
     # --- snapshot / restore (checkpoint-resume, SURVEY.md §5) -------------
     #
@@ -431,27 +773,32 @@ class ServerState:
     # (state.rs holds only in-memory maps).  In-memory remains this
     # framework's default for parity; snapshots are OPT-IN new capability
     # (--state-file).  Scope: users and sessions — challenges are 300-second
-    # single-use nonces, and persisting them would extend their attack
-    # window across restarts for no operational benefit (clients simply
-    # re-request).  Format: versioned JSON, public data only (statements
-    # are public by protocol design; session tokens are bearer secrets, so
-    # the file must be protected like a session store — written 0600).
-    # With a durability journal attached, each snapshot also records the
-    # WAL sequence number it covers ("wal_seq"), so boot-time recovery
-    # replays only the log suffix beyond it (cpzk_tpu/durability/).
+    # single-use nonces, and persisting them in the long-lived snapshot
+    # would extend their attack window across restarts for no operational
+    # benefit; in-flight logins instead survive through their journaled
+    # create/consume WAL records, which recovery replays regardless of the
+    # snapshot's covered sequence number (bounded by WAL compaction — see
+    # docs/operations.md).  Format: versioned JSON, public data only
+    # (statements are public by protocol design; session tokens are bearer
+    # secrets, so the file must be protected like a session store — written
+    # 0600).  With a durability journal attached, each snapshot also
+    # records the WAL sequence number it covers ("wal_seq"), so boot-time
+    # recovery replays only the log suffix beyond it (cpzk_tpu/durability/).
 
     SNAPSHOT_VERSION = 1
 
     async def snapshot(self, path: str) -> bool:
         """Write users + live sessions to ``path`` (JSON); returns whether
         a write happened (skipped when nothing changed since the last
-        snapshot).  The in-memory copy is taken under the state lock; the
-        serialization + fsync + atomic rename run on a worker thread so
-        the event loop (and every handler waiting on the lock) never
-        stalls on disk I/O.  Whole calls serialize on a snapshot lock so
-        overlapping writers (cleanup sweep vs shutdown) rename in
-        document-build order — otherwise an older document could land
-        over a newer one with ``_persist_dirty`` already false."""
+        snapshot).  The in-memory copy is built in one synchronous block —
+        the event loop cannot interleave a mutating handler into it, so
+        the document is a consistent cut without holding any shard lock.
+        The serialization + fsync + atomic rename run on a worker thread
+        so the event loop never stalls on disk I/O.  Whole calls serialize
+        on a snapshot lock so overlapping writers (cleanup sweep vs
+        shutdown) rename in document-build order — otherwise an older
+        document could land over a newer one with ``_persist_dirty``
+        already false."""
         import asyncio as _asyncio
         import json
         import os
@@ -460,38 +807,40 @@ class ServerState:
 
         eb = Ristretto255.element_to_bytes
         async with self._snapshot_lock:
-            async with self._lock:
-                if not self._persist_dirty:
-                    return False
-                doc = {
-                    "version": self.SNAPSHOT_VERSION,
-                    "users": {
-                        uid: {
-                            "y1": eb(u.statement.y1).hex(),
-                            "y2": eb(u.statement.y2).hex(),
-                            "registered_at": u.registered_at,
-                        }
-                        for uid, u in self._users.items()
-                    },
-                    "sessions": [
-                        {
-                            "token": s.token,
-                            "user_id": s.user_id,
-                            "created_at": s.created_at,
-                            "expires_at": s.expires_at,
-                        }
-                        for s in self._sessions.values()
-                        if not s.is_expired()
-                    ],
-                }
-                covered: tuple[int, int] | None = None
-                if self.journal is not None:
-                    # captured under the state lock (appends hold it too),
-                    # so this (seq, byte offset) pair names EXACTLY the WAL
-                    # prefix this document covers — the compaction watermark
-                    doc["wal_seq"] = self.journal.seq
-                    covered = (self.journal.seq, self.journal.size)
-                self._persist_dirty = False
+            if not self._persist_dirty:
+                return False
+            doc = {
+                "version": self.SNAPSHOT_VERSION,
+                "users": {
+                    uid: {
+                        "y1": eb(u.statement.y1).hex(),
+                        "y2": eb(u.statement.y2).hex(),
+                        "registered_at": u.registered_at,
+                    }
+                    for shard in self._shards
+                    for uid, u in shard._users.items()
+                },
+                "sessions": [
+                    {
+                        "token": s.token,
+                        "user_id": s.user_id,
+                        "created_at": s.created_at,
+                        "expires_at": s.expires_at,
+                    }
+                    for shard in self._shards
+                    for s in shard._sessions.values()
+                    if not s.is_expired()
+                ],
+            }
+            covered: tuple[int, int] | None = None
+            if self.journal is not None:
+                # captured in the same synchronous block as the document
+                # build (appends run under shard locks on this same event
+                # loop), so this (seq, byte offset) pair names EXACTLY the
+                # WAL prefix this document covers — the compaction watermark
+                doc["wal_seq"] = self.journal.seq
+                covered = (self.journal.seq, self.journal.size)
+            self._persist_dirty = False
 
             def write() -> None:
                 # unique tmp name so a racing writer can never rename a
@@ -614,12 +963,16 @@ class ServerState:
                 raise InvalidParams("Snapshot exceeds a per-user session cap")
             sessions[data.token] = data
             per_user.append(data.token)
-        async with self._lock:
-            if self._users or self._sessions:
-                raise InvalidParams("restore requires an empty state")
-            self._users = users
-            self._sessions = sessions
-            self._user_sessions = user_sessions
-            self._persist_dirty = True  # freshly-restored state is unsaved
-            self.restored_wal_seq = wal_seq
-            return len(users), len(sessions)
+        # commit: distribute into the owning shards.  Boot-time and
+        # single-threaded (like replay_journal_record), so no locks.
+        if self._total_users() or self._total_sessions():
+            raise InvalidParams("restore requires an empty state")
+        for uid, u in users.items():
+            self._shard_for_user(uid)._users[uid] = u
+        for token, s in sessions.items():
+            self._shard_for_user(s.user_id)._sessions[token] = s
+        for uid, toks in user_sessions.items():
+            self._shard_for_user(uid)._user_sessions[uid] = toks
+        self._persist_dirty = True  # freshly-restored state is unsaved
+        self.restored_wal_seq = wal_seq
+        return len(users), len(sessions)

@@ -38,6 +38,7 @@ from cpzk_tpu.resilience import RetryBudget, RetryPolicy
 from cpzk_tpu.resilience.breaker import BreakerState, CircuitBreaker
 from cpzk_tpu.resilience.faults import FaultInjectionBackend, FaultPlan
 from cpzk_tpu.server import RateLimiter, ServerState, metrics
+from cpzk_tpu.server.state import UserData
 from cpzk_tpu.server.batching import DeadlineExceeded, DynamicBatcher, QueueFull
 from cpzk_tpu.server.service import serve
 
@@ -1079,3 +1080,356 @@ def test_latency_spikes_do_not_trip_the_breaker():
     assert run(main()) == [None] * 3
     assert backend.state is BreakerState.CLOSED
     assert fault.batches_seen >= 1 and fault.faults_raised == 0
+
+
+# --- replication failover: kill-primary -> promote -> login ------------------
+#
+# ISSUE 8 acceptance: SIGKILL the primary under live gRPC traffic with
+# fsync=always + sync replication — the standby promotes within the lease
+# window, a previously registered user completes a full challenge→verify
+# login against the promoted node, no acknowledged write is lost, and the
+# deposed primary's ShipSegment is fenced by epoch.
+
+
+async def _make_repl_pair(tmp_path, lease_ms=400.0, renew_ms=40.0,
+                          mode="sync", primary_faults=None):
+    """(primary side, standby side), both serving real gRPC."""
+    from cpzk_tpu.durability import DurabilityManager
+    from cpzk_tpu.replication import SegmentShipper, StandbyReplica
+    from cpzk_tpu.server.config import DurabilitySettings, ReplicationSettings
+
+    sstate = ServerState()
+    smgr = DurabilityManager(
+        sstate, DurabilitySettings(enabled=True, fsync="always"),
+        str(tmp_path / "standby.json"),
+    )
+    await smgr.recover()
+    replica = StandbyReplica(
+        sstate, smgr,
+        ReplicationSettings(
+            enabled=True, role="standby", lease_ms=lease_ms,
+            renew_interval_ms=renew_ms, mode=mode,
+        ),
+    )
+    sserver, sport = await serve(
+        sstate, RateLimiter(100_000, 100_000), port=0, replica=replica
+    )
+    replica.start()
+
+    pstate = ServerState()
+    pmgr = DurabilityManager(
+        pstate, DurabilitySettings(enabled=True, fsync="always"),
+        str(tmp_path / "primary.json"),
+    )
+    await pmgr.recover()
+    psettings = ReplicationSettings(
+        enabled=True, role="primary", peer=f"127.0.0.1:{sport}",
+        lease_ms=lease_ms, renew_interval_ms=renew_ms, mode=mode,
+    )
+    shipper = SegmentShipper(pstate, pmgr, psettings, faults=primary_faults)
+    pmgr.attach_shipper(shipper)
+    if mode == "sync":
+        pstate.attach_replication_barrier(shipper.wait_replicated)
+    pserver, pport = await serve(
+        pstate, RateLimiter(100_000, 100_000), port=0
+    )
+    shipper.start()
+    return (
+        (pstate, pmgr, shipper, pserver, pport),
+        (sstate, smgr, replica, sserver, sport),
+    )
+
+
+async def _await_role(replica, role, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while replica.role != role:
+        assert time.monotonic() < deadline, (
+            f"standby never became {role} (still {replica.role})"
+        )
+        await asyncio.sleep(0.02)
+
+
+def test_kill_primary_promote_login_zero_acknowledged_loss(tmp_path):
+    """THE failover acceptance scenario, end to end over real gRPC."""
+    from cpzk_tpu.client.__main__ import do_login, do_register
+    from cpzk_tpu.replication import SegmentShipper
+    from cpzk_tpu.server.config import ReplicationSettings
+
+    async def main():
+        (pside, sside) = await _make_repl_pair(tmp_path, lease_ms=400,
+                                               renew_ms=40, mode="sync")
+        pstate, pmgr, shipper, pserver, pport = pside
+        sstate, smgr, replica, sserver, sport = sside
+        lease_t0 = None
+        try:
+            async with AuthClient(f"127.0.0.1:{pport}") as c:
+                # live traffic against the primary: registration + a full
+                # login (session + journaled challenge lifecycle)
+                assert "Registered" in await do_register(c, "alice", "pw-a")
+                out = await do_login(c, "alice", "pw-a")
+                assert "Login OK" in out
+                pre_crash_token = out.split("session: ")[1].strip()
+                assert "Registered" in await do_register(c, "bob", "pw-b")
+            # every acknowledged write is standby-applied (sync mode)
+            assert replica.applied_seq == pmgr.wal.seq
+
+            # the standby refuses auth traffic before promotion, and its
+            # readiness view says so (liveness stays SERVING)
+            from cpzk_tpu.server.proto import load_health_pb2
+
+            hst = load_health_pb2().HealthCheckResponse.ServingStatus
+            async with AuthClient(f"127.0.0.1:{sport}") as c:
+                with pytest.raises(grpc.aio.AioRpcError) as exc:
+                    await c.create_challenge("alice")
+                assert exc.value.code() == grpc.StatusCode.UNAVAILABLE
+                assert (
+                    await c.health_check(service="readiness")
+                ).status == hst.NOT_SERVING
+                assert (await c.health_check()).status == hst.SERVING
+
+            # SIGKILL stand-in: shipper dies mid-air, listener vanishes
+            lease_t0 = time.monotonic()
+            await shipper.kill()
+            await pserver.stop(None)
+
+            # the standby promotes itself within the lease window
+            await _await_role(replica, "primary")
+            took = time.monotonic() - lease_t0
+            assert took < 5.0, f"promotion took {took:.1f}s"
+            assert replica.epoch == 2
+
+            # ... and serves a FULL login for a pre-crash user: fresh
+            # challenge, proof bound to it, verify, session minted
+            async with AuthClient(f"127.0.0.1:{sport}") as c:
+                assert (
+                    await c.health_check(service="readiness")
+                ).status == hst.SERVING
+                assert "Login OK" in await do_login(c, "alice", "pw-a")
+                assert "Login OK" in await do_login(c, "bob", "pw-b")
+                assert "Login OK" not in await do_login(c, "alice", "wrong")
+            # no acknowledged write lost: the pre-crash session survives
+            assert await sstate.validate_session(pre_crash_token) == "alice"
+
+            # the deposed primary's ShipSegment is fenced by epoch
+            deposed = SegmentShipper(
+                pstate, pmgr,
+                ReplicationSettings(
+                    enabled=True, role="primary",
+                    peer=f"127.0.0.1:{sport}",
+                    lease_ms=400, renew_interval_ms=40,
+                ),
+            )
+            pstate.attach_replication_barrier(None)
+            await pstate.register_user(UserData("fork", _stmt(), 1))
+            fenced_before = replica.applier.fenced
+            deposed.start()
+            deadline = time.monotonic() + 5
+            while not deposed.fenced and time.monotonic() < deadline:
+                await asyncio.sleep(0.02)
+            assert deposed.fenced
+            assert replica.applier.fenced > fenced_before
+            assert await sstate.get_user("fork") is None  # never applied
+            await deposed.kill()
+        finally:
+            await shipper.kill()
+            await replica.stop()
+            await sserver.stop(None)
+
+    run(main())
+
+
+def _stmt():
+    rng = SecureRng()
+    return Prover(
+        Parameters.new(), Witness(Ristretto255.random_scalar(rng))
+    ).statement
+
+
+@pytest.mark.parametrize("point,occurrence,expect_applied", [
+    # primary dies before anything ships: standby promotes clean + empty
+    ("pre_ship", 0, 0),
+    # primary dies mid-transfer of its SECOND segment: the torn blob is
+    # refused whole, the previously-applied prefix survives promotion
+    ("mid_segment", 1, 1),
+])
+def test_promotion_after_ship_crash_points(tmp_path, point, occurrence,
+                                           expect_applied):
+    from cpzk_tpu.resilience.faults import FaultPlan as _FaultPlan
+
+    async def main():
+        plan = _FaultPlan().crash_on(point, occurrence=occurrence)
+        # async mode: the sync barrier would (correctly) refuse to ack the
+        # write the crash point strands — here we pin standby behavior
+        (pside, sside) = await _make_repl_pair(
+            tmp_path, lease_ms=300, renew_ms=30, mode="async",
+            primary_faults=plan,
+        )
+        pstate, pmgr, shipper, pserver, pport = pside
+        sstate, smgr, replica, sserver, sport = sside
+        try:
+            # let an empty-log renewal arm the standby's lease first, so
+            # the scheduled ship-crash cannot strand an unarmed standby
+            deadline = time.monotonic() + 5
+            while replica.lease_remaining_s is None:
+                assert time.monotonic() < deadline
+                await asyncio.sleep(0.01)
+            await pstate.register_user(UserData("u0", _stmt(), 1))
+            if occurrence > 0:
+                # let the first segment land before arming the second
+                deadline = time.monotonic() + 5
+                while replica.applied_seq < 1 and time.monotonic() < deadline:
+                    await asyncio.sleep(0.01)
+                assert replica.applied_seq == 1
+                await pstate.register_user(UserData("u1", _stmt(), 1))
+            # the crash point fires inside the shipping loop and kills it
+            deadline = time.monotonic() + 5
+            while shipper.crashed is None and time.monotonic() < deadline:
+                await asyncio.sleep(0.01)
+            assert shipper.crashed is not None
+            await pserver.stop(None)
+
+            await _await_role(replica, "primary")
+            assert replica.applied_seq == expect_applied
+            if point == "mid_segment":
+                # the torn segment was rejected WHOLE: prefix intact,
+                # nothing half-applied
+                assert replica.applier.segments_rejected >= 1
+                assert await sstate.get_user("u0") is not None
+                assert await sstate.get_user("u1") is None
+            else:
+                assert await sstate.user_count() == 0
+        finally:
+            await shipper.kill()
+            await replica.stop()
+            await sserver.stop(None)
+
+    run(main())
+
+
+_REPL_KILL_CHILD = """
+import asyncio, sys
+sys.path.insert(0, {root!r})
+
+from cpzk_tpu.client.kdf import password_to_scalar
+from cpzk_tpu import Parameters, Prover, Witness
+from cpzk_tpu.durability import DurabilityManager
+from cpzk_tpu.replication import SegmentShipper
+from cpzk_tpu.server.config import DurabilitySettings, ReplicationSettings
+from cpzk_tpu.server.state import ServerState, UserData
+
+async def main():
+    port = int(sys.argv[1])
+    state = ServerState()
+    mgr = DurabilityManager(
+        state, DurabilitySettings(enabled=True, fsync="always"),
+        {state_file!r},
+    )
+    await mgr.recover()
+    settings = ReplicationSettings(
+        enabled=True, role="primary", peer="127.0.0.1:%d" % port,
+        lease_ms=800, renew_interval_ms=40, mode="sync",
+    )
+    shipper = SegmentShipper(state, mgr, settings)
+    mgr.attach_shipper(shipper)
+    state.attach_replication_barrier(shipper.wait_replicated)
+    shipper.start()
+    params = Parameters.new()
+    i = 0
+    while True:
+        uid = "user-%04d" % i
+        st = Prover(
+            params, Witness(password_to_scalar("pw-" + uid, uid))
+        ).statement
+        await state.register_user(UserData(uid, st, 1))
+        # returned: locally fsynced AND standby-applied (sync mode)
+        print("ACK " + uid, flush=True)
+        i += 1
+
+asyncio.run(main())
+"""
+
+
+@pytest.mark.slow
+def test_sigkill_primary_two_process_failover_zero_loss(tmp_path):
+    """The real thing: the primary is a separate OS process registering
+    users over sync replication; SIGKILL it mid-traffic.  The in-parent
+    standby promotes on lease expiry, holds every acknowledged write,
+    and serves a full challenge→verify login for a pre-kill user."""
+    import os
+    import pathlib
+    import signal as _signal
+    import sys as _sys
+
+    from cpzk_tpu.client.__main__ import do_login
+
+    root = str(pathlib.Path(__file__).resolve().parent.parent)
+    script = _REPL_KILL_CHILD.format(
+        root=root, state_file=str(tmp_path / "primary.json")
+    )
+
+    async def main():
+        from cpzk_tpu.durability import DurabilityManager
+        from cpzk_tpu.replication import StandbyReplica
+        from cpzk_tpu.server.config import (
+            DurabilitySettings,
+            ReplicationSettings,
+        )
+
+        sstate = ServerState()
+        smgr = DurabilityManager(
+            sstate, DurabilitySettings(enabled=True, fsync="always"),
+            str(tmp_path / "standby.json"),
+        )
+        await smgr.recover()
+        replica = StandbyReplica(
+            sstate, smgr,
+            ReplicationSettings(
+                enabled=True, role="standby",
+                lease_ms=800, renew_interval_ms=40,
+            ),
+        )
+        sserver, sport = await serve(
+            sstate, RateLimiter(100_000, 100_000), port=0, replica=replica
+        )
+        replica.start()
+
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        proc = await asyncio.create_subprocess_exec(
+            _sys.executable, "-u", "-c", script, str(sport),
+            stdout=asyncio.subprocess.PIPE,
+            stderr=asyncio.subprocess.PIPE,
+            env=env, cwd=root,
+        )
+        acked = []
+        try:
+            while len(acked) < 6:
+                line = await asyncio.wait_for(
+                    proc.stdout.readline(), timeout=120
+                )
+                assert line, (await proc.stderr.read()).decode()
+                if line.startswith(b"ACK "):
+                    acked.append(line.split()[1].decode())
+            # kill without any grace, mid-traffic (likely mid-segment)
+            proc.send_signal(_signal.SIGKILL)
+            await proc.wait()
+
+            await _await_role(replica, "primary", timeout=15.0)
+            # zero acknowledged-write loss: sync mode means every ACK was
+            # standby-applied before the child printed it
+            for uid in acked:
+                assert await sstate.get_user(uid) is not None, (
+                    f"acknowledged write {uid} lost across failover"
+                )
+            # and the promoted node completes a full login for one
+            async with AuthClient(f"127.0.0.1:{sport}") as c:
+                uid = acked[len(acked) // 2]
+                assert "Login OK" in await do_login(c, uid, "pw-" + uid)
+        finally:
+            if proc.returncode is None:
+                proc.kill()
+                await proc.wait()
+            await replica.stop()
+            await sserver.stop(None)
+
+    run(main())

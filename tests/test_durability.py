@@ -440,6 +440,18 @@ def test_replay_rejects_what_the_rpc_would(tmp_path):
         ({"seq": 9, "type": "register_user"}, "malformed"),
         ({"seq": 10, "type": "register_user", "user_id": "mallory",
           "y1": "zz", "y2": y2, "registered_at": 1}, "malformed"),
+        # challenge lifecycle records go through the same boundary
+        ({"seq": 11, "type": "create_challenge", "challenge_id": "aa" * 32,
+          "user_id": "nobody", "created_at": 10, "expires_at": 20},
+         "unregistered"),
+        ({"seq": 12, "type": "create_challenge", "challenge_id": "aa" * 32,
+          "user_id": "alice", "created_at": 10, "expires_at": 10 ** 9},
+         "expiry"),
+        ({"seq": 13, "type": "consume_challenge", "challenge_id": "bb" * 32},
+         "not found"),
+        ({"seq": 14, "type": "create_challenge", "challenge_id": "zz",
+          "user_id": "alice", "created_at": 10, "expires_at": 20},
+         "malformed"),
     ]
     for rec, needle in cases:
         msg = st.replay_journal_record(rec)
@@ -606,6 +618,62 @@ def test_grpc_crash_recovery_without_any_snapshot(tmp_path):
             assert "Login OK" in await do_login(c, "carol", "pw-carol")
             assert "Login OK" not in await do_login(c, "carol", "wrong")
         await server2.stop(None)
+
+    run(main())
+
+
+def test_crash_mid_login_recovers_inflight_challenge(tmp_path):
+    """Challenge lifecycle journaling (ISSUE 8 satellite): a challenge
+    issued before a crash completes its login after the reboot — even
+    when a snapshot landed in between (challenge records bypass the
+    covered-seq replay cut, because snapshots deliberately exclude
+    challenges) — and stays consume-once across a second reboot."""
+    from cpzk_tpu.client import AuthClient
+    from cpzk_tpu.client.__main__ import do_register
+    from cpzk_tpu.client.kdf import password_to_scalar
+    from cpzk_tpu.core.transcript import Transcript
+    from cpzk_tpu.server import RateLimiter
+    from cpzk_tpu.server.service import serve
+
+    async def main():
+        state, mgr = make_manager(tmp_path)
+        await mgr.recover()
+        server, port = await serve(state, RateLimiter(1000, 1000), port=0)
+        async with AuthClient(f"127.0.0.1:{port}") as c:
+            assert "Registered" in await do_register(c, "carol", "pw-carol")
+            ch = await c.create_challenge("carol")
+            cid = bytes(ch.challenge_id)
+        # a cleanup-sweep snapshot lands between challenge creation and
+        # the crash: users/sessions replay only past its covered seq, but
+        # the in-flight challenge must still come back from the log
+        assert await mgr.checkpoint() is True
+        await server.stop(None)
+        records = read_frames(mgr.wal_path)[0]
+        assert any(r["type"] == "create_challenge" for r in records)
+
+        # crash-reboot: the same challenge completes the login
+        state2, mgr2 = make_manager(tmp_path)
+        await mgr2.recover()
+        assert await state2.challenge_count() == 1
+        server2, port2 = await serve(state2, RateLimiter(1000, 1000), port=0)
+        async with AuthClient(f"127.0.0.1:{port2}") as c:
+            prover = Prover(params, Witness(password_to_scalar("pw-carol", "carol")))
+            t = Transcript()
+            t.append_context(cid)
+            proof = prover.prove_with_transcript(rng, t)
+            resp = await c.verify_proof("carol", cid, proof.to_bytes())
+            assert resp.success and resp.session_token
+        await server2.stop(None)
+
+        # the consume was journaled too: a third boot does NOT resurrect
+        # the spent challenge (consume-once survives the crash)
+        state3, mgr3 = make_manager(tmp_path)
+        await mgr3.recover()
+        assert await state3.challenge_count() == 0
+        assert await state3.session_count() == 1  # the minted session did
+        mgr3.wal.close()
+        mgr2.wal.close()
+        mgr.wal.close()
 
     run(main())
 

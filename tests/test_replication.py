@@ -1,0 +1,690 @@
+"""Replicated server state (ISSUE 8): sharded locks, WAL segment
+shipping, lease-based promotion.
+
+Covers the pieces the chaos acceptance scenario (``test_chaos.py``)
+composes: shard routing + the contention contract, segment
+sealing/validation, the standby applier's idempotency/fencing/gap
+semantics, epoch persistence, promotion, the sync-mode acknowledgement
+barrier, compaction clamping, and the ``[replication]`` config surface
+(drift guard, env precedence, validation — including the
+lease-must-exceed-renew rejection).
+"""
+
+import asyncio
+import dataclasses
+import os
+import pathlib
+import re
+import zlib
+
+import pytest
+
+from cpzk_tpu import Parameters, Prover, SecureRng, Witness
+from cpzk_tpu.core.ristretto import Ristretto255
+from cpzk_tpu.durability import DurabilityManager
+from cpzk_tpu.durability.wal import encode_record, read_frames
+from cpzk_tpu.replication import (
+    SegmentApplier,
+    SegmentShipper,
+    StandbyReplica,
+    load_epoch,
+    seal_segment,
+    split_records,
+    store_epoch,
+    validate_segment,
+)
+from cpzk_tpu.resilience.faults import CrashPoint, FaultPlan
+from cpzk_tpu.server.config import (
+    DurabilitySettings,
+    RateLimiter,
+    ReplicationSettings,
+    ServerConfig,
+)
+from cpzk_tpu.server.state import ServerState, UserData
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+
+rng = SecureRng()
+params = Parameters.new()
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def make_statement():
+    return Prover(params, Witness(Ristretto255.random_scalar(rng))).statement
+
+
+def uid_on_shard(state: ServerState, shard: int, avoid: set | None = None) -> str:
+    """A user id hashing to ``shard`` under ``state``'s shard count."""
+    avoid = avoid or set()
+    i = 0
+    while True:
+        uid = f"user-{i}"
+        if uid not in avoid and state._shard_index(uid) == shard:
+            return uid
+        i += 1
+
+
+def make_records(n, start_seq=1, rtype="register_user"):
+    stmts = [make_statement() for _ in range(n)]
+    eb = Ristretto255.element_to_bytes
+    return [
+        {
+            "seq": start_seq + i, "type": rtype, "user_id": f"user-{i}",
+            "y1": eb(stmts[i].y1).hex(), "y2": eb(stmts[i].y2).hex(),
+            "registered_at": 1,
+        }
+        for i in range(n)
+    ]
+
+
+async def make_pair(tmp_path, lease_ms=400.0, renew_ms=40.0, mode="sync",
+                    segment_bytes=65536, standby_faults=None,
+                    primary_faults=None, auto_promote=True):
+    """(primary side, standby side) wired over a real gRPC link."""
+    from cpzk_tpu.server.service import serve
+
+    sstate = ServerState()
+    smgr = DurabilityManager(
+        sstate, DurabilitySettings(enabled=True),
+        str(tmp_path / "standby.json"), faults=standby_faults,
+    )
+    await smgr.recover()
+    ssettings = ReplicationSettings(
+        enabled=True, role="standby", lease_ms=lease_ms,
+        renew_interval_ms=renew_ms, mode=mode, auto_promote=auto_promote,
+    )
+    replica = StandbyReplica(sstate, smgr, ssettings, faults=standby_faults)
+    sserver, sport = await serve(
+        sstate, RateLimiter(100_000, 100_000), port=0, replica=replica
+    )
+    replica.start()
+
+    pstate = ServerState()
+    pmgr = DurabilityManager(
+        pstate, DurabilitySettings(enabled=True),
+        str(tmp_path / "primary.json"), faults=primary_faults,
+    )
+    await pmgr.recover()
+    psettings = ReplicationSettings(
+        enabled=True, role="primary", peer=f"127.0.0.1:{sport}",
+        lease_ms=lease_ms, renew_interval_ms=renew_ms, mode=mode,
+        segment_bytes=segment_bytes,
+    )
+    shipper = SegmentShipper(pstate, pmgr, psettings, faults=primary_faults)
+    pmgr.attach_shipper(shipper)
+    if mode == "sync":
+        pstate.attach_replication_barrier(shipper.wait_replicated)
+    shipper.start()
+    return (pstate, pmgr, shipper), (sstate, smgr, replica, sserver, sport)
+
+
+async def wait_for(predicate, timeout=5.0, interval=0.02):
+    deadline = asyncio.get_running_loop().time() + timeout
+    while not predicate():
+        if asyncio.get_running_loop().time() > deadline:
+            raise AssertionError("condition not reached in time")
+        await asyncio.sleep(interval)
+
+
+# --- sharded state ----------------------------------------------------------
+
+
+class TestShardedState:
+    def test_shard_count_bounds(self):
+        with pytest.raises(ValueError):
+            ServerState(shards=0)
+        with pytest.raises(ValueError):
+            ServerState(shards=257)
+        assert ServerState(shards=1).num_shards == 1
+
+    def test_stable_hash_and_tags(self):
+        st = ServerState(shards=8)
+        uid = "alice"
+        idx = st._shard_index(uid)
+        assert idx == zlib.crc32(b"alice") % 8  # stable across processes
+        cid = st.tag_challenge_id(uid, b"\xff" * 32)
+        assert cid[0] == idx and cid[1:] == b"\xff" * 31 and len(cid) == 32
+        tok = st.tag_session_token(uid, "f" * 64)
+        assert tok == f"{idx:02x}" + "f" * 62
+
+    def test_tagged_routing_and_untagged_fallback(self):
+        async def main():
+            st = ServerState(shards=8)
+            await st.register_user(UserData("alice", make_statement(), 1))
+            # tagged challenge: routed by the tag byte
+            cid = st.tag_challenge_id("alice", os.urandom(32))
+            await st.create_challenge("alice", cid)
+            assert st._locate_challenge(cid) == st._shard_index("alice")
+            got = await st.consume_challenge(cid)
+            assert got.user_id == "alice"
+            # untagged (legacy/test) ids fall back to the scan and still work
+            raw = b"c" * 32
+            await st.create_challenge("alice", raw)
+            assert (await st.consume_challenge(raw)).user_id == "alice"
+            # tagged session token routes; untagged falls back
+            tok = st.tag_session_token("alice", "a" * 64)
+            await st.create_session(tok, "alice")
+            assert await st.validate_session(tok) == "alice"
+            await st.create_session("tok", "alice")
+            assert await st.validate_session("tok") == "alice"
+            await st.revoke_session("tok")
+            with pytest.raises(Exception, match="Invalid session token"):
+                await st.validate_session("tok")
+
+        run(main())
+
+    def test_distinct_users_do_not_serialize(self):
+        """THE contention pin (ISSUE 8 acceptance): holding one shard's
+        lock blocks same-shard users but not users on other shards — the
+        per-RPC global serialization is gone."""
+
+        async def main():
+            st = ServerState(shards=4)
+            a = uid_on_shard(st, 0)
+            same = uid_on_shard(st, 0, avoid={a})
+            other = uid_on_shard(st, 1)
+            async with st._shard_for_user(a).lock:
+                # a different user's registration proceeds under the held lock
+                await asyncio.wait_for(
+                    st.register_user(UserData(other, make_statement(), 1)),
+                    timeout=2.0,
+                )
+                # a SAME-shard registration must block on the held lock
+                with pytest.raises(asyncio.TimeoutError):
+                    await asyncio.wait_for(
+                        st.register_user(UserData(same, make_statement(), 1)),
+                        timeout=0.1,
+                    )
+            # released: the same-shard user registers fine now
+            await st.register_user(UserData(same, make_statement(), 1))
+            assert await st.user_count() == 2  # other + same (a never registered)
+
+        run(main())
+
+    def test_views_merge_shards(self):
+        async def main():
+            st = ServerState(shards=4)
+            uids = [uid_on_shard(st, i) for i in range(4)]
+            for u in uids:
+                await st.register_user(UserData(u, make_statement(), 1))
+                await st.create_session(
+                    st.tag_session_token(u, os.urandom(32).hex()), u
+                )
+            assert sorted(st._users) == sorted(uids)
+            assert len(st._sessions) == 4
+            for tok, sess in st._sessions.items():
+                assert st._sessions[tok] is sess
+                assert tok in st._sessions
+            assert "nope" not in st._sessions
+
+        run(main())
+
+
+# --- segments ---------------------------------------------------------------
+
+
+class TestSegments:
+    def test_seal_split_validate_roundtrip(self):
+        records = make_records(5)
+        segs = split_records(records, epoch=1, first_index=0, segment_bytes=400)
+        assert len(segs) == 3  # 2 + 2 sealed at ~400B, 1-record remainder
+        assert [s.index for s in segs] == list(range(len(segs)))
+        assert segs[0].sealed and not segs[-1].sealed  # tail-follow
+        seen = []
+        for seg in segs:
+            got, err = validate_segment(seg)
+            assert err is None
+            seen.extend(r["seq"] for r in got)
+        assert seen == [r["seq"] for r in records]
+
+    def test_validation_rejects_torn_and_tampered(self):
+        seg = seal_segment(1, 0, make_records(3))
+        ok, err = validate_segment(seg)
+        assert err is None and len(ok) == 3
+        torn = dataclasses.replace(seg, frames=seg.frames[: len(seg.frames) // 2])
+        assert validate_segment(torn)[1] is not None
+        flipped = bytearray(seg.frames)
+        flipped[12] ^= 0x40
+        assert "CRC" in validate_segment(
+            dataclasses.replace(seg, frames=bytes(flipped))
+        )[1]
+        assert "first_seq" in validate_segment(
+            dataclasses.replace(seg, first_seq=99)
+        )[1]
+        assert "last_seq" in validate_segment(
+            dataclasses.replace(seg, last_seq=99)
+        )[1]
+        assert validate_segment(dataclasses.replace(seg, frames=b""))[1]
+
+    def test_applier_semantics(self):
+        """Duplicate = idempotent accept; gap = reject; stale epoch =
+        fenced; higher epoch = adopted; invalid records skip, not crash."""
+        state = ServerState()
+        applier = SegmentApplier(state, epoch=2)
+        records = make_records(4)
+        seg01 = seal_segment(2, 0, records[:2])
+        seg23 = seal_segment(2, 1, records[2:])
+        accepted, _, new = applier.prepare(seg01)
+        assert accepted and len(new) == 2
+        applier.commit(new)
+        assert applier.applied_seq == 2
+        assert run(state.user_count()) == 2
+        # duplicate: accepted, nothing new
+        accepted, msg, new = applier.prepare(seg01)
+        assert accepted and not new and "duplicate" in msg
+        # gap: seq 5.. while applied is 2
+        gap = seal_segment(2, 5, make_records(1, start_seq=5))
+        accepted, msg, _ = applier.prepare(gap)
+        assert not accepted and "gap" in msg
+        # stale epoch: fenced, no state change
+        stale = seal_segment(1, 9, records[2:])
+        accepted, msg, _ = applier.prepare(stale)
+        assert not accepted and "fenced" in msg and applier.fenced == 1
+        # higher epoch: adopted
+        future = seal_segment(3, 1, records[2:])
+        accepted, _, new = applier.prepare(future)
+        assert accepted and applier.epoch == 3
+        applier.commit(new)
+        assert run(state.user_count()) == 4
+        # overlap (partially applied): only the new suffix applies
+        overlap = seal_segment(3, 2, make_records(3, start_seq=3))
+        accepted, _, new = applier.prepare(overlap)
+        assert accepted and [r["seq"] for r in new] == [5]
+        applier.commit(new)  # duplicate user id: skipped by the validators
+        assert applier.records_skipped == 1 and applier.applied_seq == 5
+        # a record the RPC would reject is skipped, never fatal
+        bad = seal_segment(3, 3, [
+            {"seq": 6, "type": "register_user", "user_id": "bad user!",
+             "y1": "00", "y2": "00", "registered_at": 1},
+        ])
+        accepted, _, new = applier.prepare(bad)
+        assert accepted
+        applier.commit(new)
+        assert applier.records_skipped == 2
+        assert applier.applied_seq == 6
+
+    def test_epoch_file_roundtrip(self, tmp_path):
+        path = str(tmp_path / "node.epoch")
+        assert load_epoch(path) == 1  # absent -> first epoch
+        store_epoch(path, 7)
+        assert load_epoch(path) == 7
+        (tmp_path / "node.epoch").write_text("garbage")
+        assert load_epoch(path) == 1
+
+
+# --- shipping + promotion over a real gRPC link ------------------------------
+
+
+class TestShipAndPromote:
+    def test_sync_barrier_and_warm_standby(self, tmp_path):
+        async def main():
+            (pstate, pmgr, shipper), (sstate, smgr, replica, sserver, _) = (
+                await make_pair(tmp_path)
+            )
+            try:
+                for i in range(5):
+                    await pstate.register_user(
+                        UserData(f"u{i}", make_statement(), 1)
+                    )
+                # sync mode: the ack barrier means the standby applied it
+                # BEFORE register_user returned — no polling needed
+                assert shipper.acked_seq == pmgr.wal.seq == replica.applied_seq
+                assert await sstate.user_count() == 5
+                assert replica.applier.records_applied == 5
+                assert shipper.segments_shipped >= 1
+                # the standby's own WAL holds the primary's frames verbatim
+                srecords, valid, total = read_frames(smgr.wal.path)
+                assert valid == total
+                assert [r["seq"] for r in srecords] == [1, 2, 3, 4, 5]
+                assert replica.status()["role"] == "standby"
+                assert shipper.status()["lag_records"] == 0
+            finally:
+                await shipper.kill()
+                await replica.stop()
+                await sserver.stop(None)
+
+        run(main())
+
+    def test_promotion_on_lease_expiry_and_epoch_fencing(self, tmp_path):
+        async def main():
+            (pstate, pmgr, shipper), (sstate, smgr, replica, sserver, sport) = (
+                await make_pair(tmp_path, lease_ms=300, renew_ms=30)
+            )
+            try:
+                await pstate.register_user(UserData("alice", make_statement(), 1))
+                assert replica.applied_seq == 1
+                assert sserver.health.standby is True
+                # SIGKILL stand-in: the shipper dies, renewals stop
+                await shipper.kill()
+                await wait_for(lambda: replica.role == "primary")
+                assert replica.epoch == 2
+                assert sserver.health.standby is False  # readiness flipped
+                assert load_epoch(replica.epoch_path) == 2
+                # deposed primary comes back and ships: fenced, no effect
+                psettings = ReplicationSettings(
+                    enabled=True, role="primary", peer=f"127.0.0.1:{sport}",
+                    lease_ms=300, renew_interval_ms=30,
+                )
+                deposed = SegmentShipper(pstate, pmgr, psettings)
+                assert deposed.epoch == 1
+                # the revived deposed primary runs async mode (a fresh
+                # process would rebuild its barrier from config)
+                pstate.attach_replication_barrier(None)
+                await pstate.register_user(UserData("evil", make_statement(), 1))
+                deposed.start()
+                await wait_for(lambda: deposed.fenced)
+                assert await sstate.get_user("evil") is None
+                assert replica.applier.fenced >= 1
+                await deposed.kill()
+                # promoting again is a no-op
+                report = await replica.promote(reason="operator")
+                assert not report["promoted"]
+            finally:
+                await shipper.kill()
+                await replica.stop()
+                await sserver.stop(None)
+
+        run(main())
+
+    def test_pre_promote_crash_point_is_retryable(self, tmp_path):
+        async def main():
+            plan = FaultPlan().crash_on("pre_promote", occurrence=0)
+            (pstate, pmgr, shipper), (sstate, smgr, replica, sserver, _) = (
+                await make_pair(tmp_path, standby_faults=plan,
+                                auto_promote=False)
+            )
+            try:
+                await pstate.register_user(UserData("alice", make_statement(), 1))
+                await shipper.kill()
+                with pytest.raises(CrashPoint):
+                    await replica.promote(reason="operator")
+                assert replica.role == "standby"  # nothing half-promoted
+                assert load_epoch(replica.epoch_path) == 1
+                report = await replica.promote(reason="operator")  # retry
+                assert report["promoted"] and replica.epoch == 2
+                assert await sstate.get_user("alice") is not None
+            finally:
+                await replica.stop()
+                await sserver.stop(None)
+
+        run(main())
+
+    def test_sync_mode_refuses_to_ack_without_standby(self, tmp_path):
+        """Zero-loss means failing the write, not lying: with the standby
+        gone and the shipper dead, a sync-mode mutation raises instead of
+        acknowledging."""
+        from cpzk_tpu.replication import ReplicationTimeout
+
+        async def main():
+            (pstate, pmgr, shipper), (sstate, smgr, replica, sserver, _) = (
+                await make_pair(tmp_path)
+            )
+            shipper.settings.sync_timeout_ms = 200.0
+            await pstate.register_user(UserData("ok", make_statement(), 1))
+            await replica.stop()
+            await sserver.stop(None)  # standby gone
+            with pytest.raises(ReplicationTimeout):
+                await pstate.register_user(UserData("lost", make_statement(), 1))
+            await shipper.kill()
+
+        run(main())
+
+    def test_restarted_primary_catches_up_against_warm_standby(self, tmp_path):
+        """A primary restart re-reads WAL history the standby already
+        holds: the re-shipped segment is an idempotent duplicate, the
+        acked offset catches up to the whole log (clearing the compaction
+        floor), and fresh writes flow normally."""
+
+        async def main():
+            (pside, sside) = await make_pair(tmp_path)
+            pstate, pmgr, shipper = pside
+            sstate, smgr, replica, sserver, sport = sside
+            try:
+                for i in range(3):
+                    await pstate.register_user(
+                        UserData(f"u{i}", make_statement(), 1)
+                    )
+                applied_before = replica.applier.records_applied
+                # "restart": a fresh shipper with zero local bookkeeping
+                await shipper.kill()
+                psettings = ReplicationSettings(
+                    enabled=True, role="primary", peer=f"127.0.0.1:{sport}",
+                    lease_ms=400, renew_interval_ms=40, mode="sync",
+                )
+                shipper2 = SegmentShipper(pstate, pmgr, psettings)
+                pmgr.attach_shipper(shipper2)
+                pstate.attach_replication_barrier(shipper2.wait_replicated)
+                shipper2.start()
+                await wait_for(
+                    lambda: shipper2.acked_offset == pmgr.wal.size
+                )
+                # duplicates were not re-applied on the standby
+                assert replica.applier.records_applied == applied_before
+                assert replica.applied_seq == 3
+                # and fresh writes replicate normally through the new shipper
+                await pstate.register_user(UserData("u3", make_statement(), 1))
+                assert replica.applied_seq == 4
+                assert await sstate.get_user("u3") is not None
+                await shipper2.kill()
+            finally:
+                await shipper.kill()
+                await replica.stop()
+                await sserver.stop(None)
+
+        run(main())
+
+    def test_compaction_clamped_to_standby_ack(self, tmp_path):
+        """A covering snapshot must not let compaction drop bytes the
+        standby has not acknowledged."""
+
+        async def main():
+            state = ServerState()
+            mgr = DurabilityManager(
+                state,
+                DurabilitySettings(enabled=True, compact_bytes=0),
+                str(tmp_path / "p.json"),
+            )
+            await mgr.recover()
+
+            class StalledShipper:
+                def __init__(self):
+                    self.rebased = 0
+
+                def safe_compact_offset(self):
+                    return 0  # standby has acknowledged nothing
+
+                def note_compacted(self, freed):
+                    self.rebased += freed
+
+            stalled = StalledShipper()
+            mgr.attach_shipper(stalled)
+            for i in range(4):
+                await state.register_user(UserData(f"u{i}", make_statement(), 1))
+            size = mgr.wal.size
+            await mgr.checkpoint()  # snapshot covers all — but acked=0
+            assert mgr.wal.size == size  # nothing compacted
+            assert stalled.rebased == 0
+
+            class CaughtUpShipper(StalledShipper):
+                def safe_compact_offset(self):
+                    return 10**9
+
+            caught = CaughtUpShipper()
+            mgr.attach_shipper(caught)
+            state._persist_dirty = True
+            await mgr.checkpoint()
+            assert mgr.wal.size == 0  # covered AND acked: compacts
+            assert caught.rebased == size
+
+        run(main())
+
+
+# --- config surface ----------------------------------------------------------
+
+
+class TestReplicationConfig:
+    def test_layering_env_precedence_and_validation(self, tmp_path, monkeypatch):
+        monkeypatch.chdir(tmp_path)  # no stray .env/config pickup
+        cfg = ServerConfig.from_env()
+        assert cfg.replication.enabled is False
+        assert cfg.replication.role == "primary"
+        assert cfg.replication.mode == "async"
+
+        (tmp_path / "server.toml").write_text(
+            "state_file = 's.json'\n"
+            "[durability]\nenabled = true\n"
+            '[replication]\nenabled = true\nrole = "standby"\n'
+            "lease_ms = 2000.0\nshards = 32\n"
+        )
+        monkeypatch.setenv("SERVER_CONFIG_PATH", str(tmp_path / "server.toml"))
+        cfg = ServerConfig.from_env()
+        assert cfg.replication.enabled is True
+        assert cfg.replication.role == "standby"
+        assert cfg.replication.lease_ms == 2000.0
+        assert cfg.replication.shards == 32
+        cfg.validate()
+        # env overrides TOML
+        monkeypatch.setenv("SERVER_REPLICATION_ROLE", "PRIMARY")
+        monkeypatch.setenv("SERVER_REPLICATION_PEER", "10.0.0.2:50051")
+        monkeypatch.setenv("SERVER_REPLICATION_MODE", "SYNC")
+        monkeypatch.setenv("SERVER_REPLICATION_RENEW_INTERVAL_MS", "250")
+        monkeypatch.setenv("SERVER_REPLICATION_AUTO_PROMOTE", "false")
+        monkeypatch.setenv("SERVER_REPLICATION_SEGMENT_BYTES", "1024")
+        monkeypatch.setenv("SERVER_REPLICATION_SYNC_TIMEOUT_MS", "750")
+        monkeypatch.setenv("SERVER_REPLICATION_EPOCH_FILE", "/tmp/e")
+        monkeypatch.setenv("SERVER_REPLICATION_SHARDS", "64")
+        cfg = ServerConfig.from_env()
+        assert cfg.replication.role == "primary"
+        assert cfg.replication.peer == "10.0.0.2:50051"
+        assert cfg.replication.mode == "sync"
+        assert cfg.replication.renew_interval_ms == 250.0
+        assert cfg.replication.auto_promote is False
+        assert cfg.replication.segment_bytes == 1024
+        assert cfg.replication.sync_timeout_ms == 750.0
+        assert cfg.replication.epoch_file == "/tmp/e"
+        assert cfg.replication.shards == 64
+        cfg.validate()
+
+    @pytest.mark.parametrize("mutate,match", [
+        (lambda c: setattr(c.replication, "role", "observer"), "role"),
+        (lambda c: setattr(c.replication, "mode", "eventual"), "mode"),
+        (lambda c: setattr(c.replication, "renew_interval_ms", 0.0),
+         "renew_interval_ms"),
+        # THE footgun: a lease the renewal cadence cannot keep alive
+        (lambda c: setattr(c.replication, "lease_ms", 500.0) or
+         setattr(c.replication, "renew_interval_ms", 500.0), "lease_ms"),
+        (lambda c: setattr(c.replication, "lease_ms", 100.0) or
+         setattr(c.replication, "renew_interval_ms", 500.0), "lease_ms"),
+        (lambda c: setattr(c.replication, "segment_bytes", 0), "segment_bytes"),
+        (lambda c: setattr(c.replication, "sync_timeout_ms", 0.0),
+         "sync_timeout_ms"),
+        (lambda c: setattr(c.replication, "shards", 0), "shards"),
+        (lambda c: setattr(c.replication, "shards", 257), "shards"),
+    ])
+    def test_validation_rejects(self, mutate, match):
+        cfg = ServerConfig()
+        mutate(cfg)
+        with pytest.raises(ValueError, match=match):
+            cfg.validate()
+
+    def test_enabled_requires_durability_and_peer(self):
+        cfg = ServerConfig()
+        cfg.replication.enabled = True
+        with pytest.raises(ValueError, match="requires durability"):
+            cfg.validate()
+        cfg.state_file = "s.json"
+        cfg.durability.enabled = True
+        with pytest.raises(ValueError, match="peer"):
+            cfg.validate()
+        cfg.replication.peer = "10.0.0.2:50051"
+        cfg.validate()
+        # a standby needs no peer
+        cfg.replication.peer = ""
+        cfg.replication.role = "standby"
+        cfg.validate()
+
+    def test_replication_config_keys_documented(self):
+        """CI drift guard: every [replication] knob ships in the TOML
+        example, the .env example, and the operations-doc knob inventory."""
+        keys = [f.name for f in dataclasses.fields(ReplicationSettings)]
+        assert keys  # the guard itself must not silently go vacuous
+
+        toml_text = (ROOT / "config" / "server.toml.example").read_text()
+        m = re.search(r"^\[replication\]$", toml_text, re.M)
+        assert m, "[replication] section missing from config/server.toml.example"
+        section = toml_text[m.end():].split("\n[", 1)[0]
+        env_text = (ROOT / ".env.example").read_text()
+        docs = (ROOT / "docs" / "operations.md").read_text()
+        for key in keys:
+            assert re.search(rf"^{key}\s*=", section, re.M), (
+                f"[replication] key {key!r} missing from "
+                "config/server.toml.example"
+            )
+            assert f"SERVER_REPLICATION_{key.upper()}" in env_text, (
+                f"SERVER_REPLICATION_{key.upper()} missing from .env.example"
+            )
+            assert f"`replication.{key}`" in docs, (
+                f"`replication.{key}` missing from the docs/operations.md "
+                "knob inventory"
+            )
+
+    def test_repl_commands(self, tmp_path):
+        from cpzk_tpu.server.__main__ import handle_command
+
+        async def main():
+            state = ServerState()
+            out, _ = await handle_command("/replication", state)
+            assert "replication disabled" in out
+            out, _ = await handle_command("/promote", state)
+            assert "nothing to promote" in out
+
+            (pside, sside) = await make_pair(tmp_path, auto_promote=False)
+            pstate, pmgr, shipper = pside
+            sstate, smgr, replica, sserver, _ = sside
+            try:
+                await pstate.register_user(
+                    UserData("alice", make_statement(), 1)
+                )
+                out, _ = await handle_command(
+                    "/replication", pstate, None, pmgr, None, shipper
+                )
+                assert "role=primary" in out and "mode=sync" in out
+                assert "acked_seq=1" in out and "fenced=False" in out
+                out, _ = await handle_command(
+                    "/replication", sstate, None, smgr, None, replica
+                )
+                assert "role=standby" in out and "applied_seq=1" in out
+                await shipper.kill()
+                out, _ = await handle_command(
+                    "/promote", sstate, None, smgr, None, replica
+                )
+                assert "PROMOTED" in out and "epoch=2" in out
+                out, _ = await handle_command(
+                    "/promote", sstate, None, smgr, None, replica
+                )
+                assert "not promoted" in out
+            finally:
+                await replica.stop()
+                await sserver.stop(None)
+
+        run(main())
+
+
+# --- the shipped frames are byte-exact --------------------------------------
+
+
+def test_shipped_frames_are_canonical():
+    """Re-encoding a parsed record reproduces the exact bytes the primary
+    framed (compact key-sorted JSON) — what lets the standby's WAL carry
+    identical frames and replay them through ordinary recovery."""
+    from cpzk_tpu.durability.wal import iter_frames
+
+    records = make_records(3)
+    frames = b"".join(encode_record(r) for r in records)
+    parsed, valid = iter_frames(frames)
+    assert valid == len(frames)
+    again = b"".join(encode_record(r) for r in parsed)
+    assert again == frames
