@@ -9,8 +9,8 @@ verification, per-item session issuance), against the reference analog
 
 Prints one JSON line per curve point:
     {"metric": "e2e_curve", "n": N, "grpc_pps": ...,
-     "grpc_pipelined_pps": ..., "direct_pps": ..., "platform": ...,
-     "backend": ..., "unit": "proofs/s"}
+     "grpc_pipelined_pps": ..., "stream_pps": ..., "direct_pps": ...,
+     "platform": ..., "backend": ..., "unit": "proofs/s"}
 
 - grpc_pps  — proofs/s through the real asyncio gRPC loopback service
               (batched RPCs of <=1000 items, reference cap parity),
@@ -22,6 +22,11 @@ Prints one JSON line per curve point:
               server verifies on a worker thread (GIL released), so one
               RPC's Python overlaps another's crypto — the many-client
               deployment shape.
+- stream_pps — proofs/s through ONE VerifyProofStream bidi stream
+              (verdict-only, no session issuance): entries feed the
+              batcher continuously with no per-RPC boundary or 1000-item
+              cap — the workload the streaming API exists for.  The
+              acceptance bar is >= 0.95x direct_pps at n=64k.
 - direct_pps — proofs/s through BatchVerifier.verify alone on the same
               backend (no RPC/session overhead); the serial gap is the
               serving layer's cost.
@@ -64,12 +69,16 @@ def build_corpus():
     return rng, params, provers
 
 
+STREAM_CHUNK = 1024    # entries packed per stream message
+
+
 async def grpc_curve_point(
     n: int, provers, rng, backend_name: str
-) -> tuple[float, float]:
-    """(serial_pps, pipelined_pps): wall time of the timed verify RPCs for
-    n proofs with one RPC in flight, then with each wave's RPCs issued
-    concurrently (~PIPELINE_WAYS at a time)."""
+) -> tuple[float, float, float]:
+    """(serial_pps, pipelined_pps, stream_pps): wall time of the timed
+    verify RPCs for n proofs with one RPC in flight, then with each
+    wave's RPCs issued concurrently (~PIPELINE_WAYS at a time), then
+    pushed through one VerifyProofStream per wave (verdict-only)."""
     import grpc  # noqa: F401  (import check before server spin-up)
 
     from cpzk_tpu import Transcript
@@ -182,11 +191,35 @@ async def grpc_curve_point(
                 done += wave
                 for s in list(state._sessions):
                     await state.revoke_session(s)
+
+            # streaming pass: every wave's proofs ride ONE bidi stream
+            # (verdict-only — mint_sessions off, the bulk-verification
+            # shape).  Entries flow into the batcher with no RPC
+            # boundary, so the device sees the same deep batches the
+            # direct path builds by hand.
+            done = 0
+            timed_s = 0.0
+            while done < n:
+                wave = min(n - done, USERS * CHALLENGES_PER_WAVE)
+                ids, cids, proofs = await make_wave(wave)
+                entries = list(zip(ids, cids, proofs))
+                t0 = time.perf_counter()
+                n_ok = 0
+                # the chunk-level iterator is the bulk-driver surface:
+                # per-verdict Python objects are pure client overhead at
+                # device-batch rates
+                async for chunk_v in client.verify_proof_stream_chunks(
+                    entries, chunk=STREAM_CHUNK
+                ):
+                    n_ok += sum(chunk_v[1])
+                timed_s += time.perf_counter() - t0
+                assert n_ok == wave, f"stream verify failed: {n_ok}/{wave}"
+                done += wave
     finally:
         if batcher is not None:
             await batcher.stop()
         await server.stop(None)
-    return n / timed, n / timed_p
+    return n / timed, n / timed_p, n / timed_s
 
 
 def direct_curve_point(n: int, provers, rng, params, backend_name: str) -> float:
@@ -270,13 +303,15 @@ def main() -> None:
         recorder = get_flight_recorder()
         recorder.clear()  # stage percentiles attribute to this n only
         direct = direct_curve_point(n, provers, rng, params, args.backend)
-        grpc_pps, grpc_pipelined = asyncio.run(
+        grpc_pps, grpc_pipelined, stream_pps = asyncio.run(
             grpc_curve_point(n, provers, rng, args.backend))
         print(json.dumps({
             "metric": "e2e_curve",
             "n": n,
             "grpc_pps": round(grpc_pps, 1),
             "grpc_pipelined_pps": round(grpc_pipelined, 1),
+            "stream_pps": round(stream_pps, 1),
+            "stream_vs_direct": round(stream_pps / direct, 3),
             "direct_pps": round(direct, 1),
             "platform": platform,
             "backend": args.backend,
@@ -286,6 +321,7 @@ def main() -> None:
         for name, pps in (
             ("e2e_curve.grpc", grpc_pps),
             ("e2e_curve.grpc_pipelined", grpc_pipelined),
+            ("e2e_curve.stream", stream_pps),
             ("e2e_curve.direct", direct),
         ):
             snapshot_entries.append(PerfEntry(

@@ -1,49 +1,17 @@
 # -*- coding: utf-8 -*-
-# Generated by the protocol buffer compiler.  DO NOT EDIT!
-# source: auth.proto
+# Generated protocol buffer code for auth.proto (rebuilt from the
+# FileDescriptorProto because protoc is unavailable in this environment;
+# see cpzk_tpu/server/proto.py -- regenerate with protoc when present).
 """Generated protocol buffer code."""
 from google.protobuf.internal import builder as _builder
 from google.protobuf import descriptor as _descriptor
 from google.protobuf import descriptor_pool as _descriptor_pool
 from google.protobuf import symbol_database as _symbol_database
-# @@protoc_insertion_point(imports)
 
 _sym_db = _symbol_database.Default()
 
 
-
-
-DESCRIPTOR = _descriptor_pool.Default().AddSerializedFile(b'\n\nauth.proto\x12\x04\x61uth\">\n\x13RegistrationRequest\x12\x0f\n\x07user_id\x18\x01 \x01(\t\x12\n\n\x02y1\x18\x02 \x01(\x0c\x12\n\n\x02y2\x18\x03 \x01(\x0c\"8\n\x14RegistrationResponse\x12\x0f\n\x07success\x18\x01 \x01(\x08\x12\x0f\n\x07message\x18\x02 \x01(\t\"#\n\x10\x43hallengeRequest\x12\x0f\n\x07user_id\x18\x01 \x01(\t\"=\n\x11\x43hallengeResponse\x12\x14\n\x0c\x63hallenge_id\x18\x01 \x01(\x0c\x12\x12\n\nexpires_at\x18\x02 \x01(\x03\"K\n\x13VerificationRequest\x12\x0f\n\x07user_id\x18\x01 \x01(\t\x12\x14\n\x0c\x63hallenge_id\x18\x02 \x01(\x0c\x12\r\n\x05proof\x18\x03 \x01(\x0c\"f\n\x14VerificationResponse\x12\x0f\n\x07success\x18\x01 \x01(\x08\x12\x0f\n\x07message\x18\x02 \x01(\t\x12\x1a\n\rsession_token\x18\x03 \x01(\tH\x00\x88\x01\x01\x42\x10\n\x0e_session_token\"S\n\x18\x42\x61tchVerificationRequest\x12\x10\n\x08user_ids\x18\x01 \x03(\t\x12\x15\n\rchallenge_ids\x18\x02 \x03(\x0c\x12\x0e\n\x06proofs\x18\x03 \x03(\x0c\"F\n\x19\x42\x61tchVerificationResponse\x12)\n\x07results\x18\x01 \x03(\x0b\x32\x18.auth.VerificationResult\"d\n\x12VerificationResult\x12\x0f\n\x07success\x18\x01 \x01(\x08\x12\x0f\n\x07message\x18\x02 \x01(\t\x12\x1a\n\rsession_token\x18\x03 \x01(\tH\x00\x88\x01\x01\x42\x10\n\x0e_session_token\"R\n\x18\x42\x61tchRegistrationRequest\x12\x10\n\x08user_ids\x18\x01 \x03(\t\x12\x11\n\ty1_values\x18\x02 \x03(\x0c\x12\x11\n\ty2_values\x18\x03 \x03(\x0c\"F\n\x19\x42\x61tchRegistrationResponse\x12)\n\x07results\x18\x01 \x03(\x0b\x32\x18.auth.RegistrationResult\"6\n\x12RegistrationResult\x12\x0f\n\x07success\x18\x01 \x01(\x08\x12\x0f\n\x07message\x18\x02 \x01(\t2\x81\x03\n\x0b\x41uthService\x12\x41\n\x08Register\x12\x19.auth.RegistrationRequest\x1a\x1a.auth.RegistrationResponse\x12P\n\rRegisterBatch\x12\x1e.auth.BatchRegistrationRequest\x1a\x1f.auth.BatchRegistrationResponse\x12\x42\n\x0f\x43reateChallenge\x12\x16.auth.ChallengeRequest\x1a\x17.auth.ChallengeResponse\x12\x44\n\x0bVerifyProof\x12\x19.auth.VerificationRequest\x1a\x1a.auth.VerificationResponse\x12S\n\x10VerifyProofBatch\x12\x1e.auth.BatchVerificationRequest\x1a\x1f.auth.BatchVerificationResponseb\x06proto3')
+DESCRIPTOR = _descriptor_pool.Default().AddSerializedFile(b'\n\nauth.proto\x12\x04auth">\n\x13RegistrationRequest\x12\x0f\n\x07user_id\x18\x01 \x01(\t\x12\n\n\x02y1\x18\x02 \x01(\x0c\x12\n\n\x02y2\x18\x03 \x01(\x0c"8\n\x14RegistrationResponse\x12\x0f\n\x07success\x18\x01 \x01(\x08\x12\x0f\n\x07message\x18\x02 \x01(\t"#\n\x10ChallengeRequest\x12\x0f\n\x07user_id\x18\x01 \x01(\t"=\n\x11ChallengeResponse\x12\x14\n\x0cchallenge_id\x18\x01 \x01(\x0c\x12\x12\n\nexpires_at\x18\x02 \x01(\x03"K\n\x13VerificationRequest\x12\x0f\n\x07user_id\x18\x01 \x01(\t\x12\x14\n\x0cchallenge_id\x18\x02 \x01(\x0c\x12\r\n\x05proof\x18\x03 \x01(\x0c"f\n\x14VerificationResponse\x12\x0f\n\x07success\x18\x01 \x01(\x08\x12\x0f\n\x07message\x18\x02 \x01(\t\x12\x1a\n\rsession_token\x18\x03 \x01(\tH\x00\x88\x01\x01B\x10\n\x0e_session_token"S\n\x18BatchVerificationRequest\x12\x10\n\x08user_ids\x18\x01 \x03(\t\x12\x15\n\rchallenge_ids\x18\x02 \x03(\x0c\x12\x0e\n\x06proofs\x18\x03 \x03(\x0c"F\n\x19BatchVerificationResponse\x12)\n\x07results\x18\x01 \x03(\x0b2\x18.auth.VerificationResult"d\n\x12VerificationResult\x12\x0f\n\x07success\x18\x01 \x01(\x08\x12\x0f\n\x07message\x18\x02 \x01(\t\x12\x1a\n\rsession_token\x18\x03 \x01(\tH\x00\x88\x01\x01B\x10\n\x0e_session_token"R\n\x18BatchRegistrationRequest\x12\x10\n\x08user_ids\x18\x01 \x03(\t\x12\x11\n\ty1_values\x18\x02 \x03(\x0c\x12\x11\n\ty2_values\x18\x03 \x03(\x0c"F\n\x19BatchRegistrationResponse\x12)\n\x07results\x18\x01 \x03(\x0b2\x18.auth.RegistrationResult"6\n\x12RegistrationResult\x12\x0f\n\x07success\x18\x01 \x01(\x08\x12\x0f\n\x07message\x18\x02 \x01(\t"r\n\x13StreamVerifyRequest\x12\x0b\n\x03ids\x18\x01 \x03(\x04\x12\x10\n\x08user_ids\x18\x02 \x03(\t\x12\x15\n\rchallenge_ids\x18\x03 \x03(\x0c\x12\x0e\n\x06proofs\x18\x04 \x03(\x0c\x12\x15\n\rmint_sessions\x18\x05 \x01(\x08"v\n\x14StreamVerifyResponse\x12\x0b\n\x03ids\x18\x01 \x03(\x04\x12\x0f\n\x07success\x18\x02 \x03(\x08\x12\x10\n\x08messages\x18\x03 \x03(\t\x12\x16\n\x0esession_tokens\x18\x04 \x03(\t\x12\x16\n\x0eretry_after_ms\x18\x05 \x01(\r2\xd1\x03\n\x0bAuthService\x12A\n\x08Register\x12\x19.auth.RegistrationRequest\x1a\x1a.auth.RegistrationResponse\x12P\n\rRegisterBatch\x12\x1e.auth.BatchRegistrationRequest\x1a\x1f.auth.BatchRegistrationResponse\x12B\n\x0fCreateChallenge\x12\x16.auth.ChallengeRequest\x1a\x17.auth.ChallengeResponse\x12D\n\x0bVerifyProof\x12\x19.auth.VerificationRequest\x1a\x1a.auth.VerificationResponse\x12S\n\x10VerifyProofBatch\x12\x1e.auth.BatchVerificationRequest\x1a\x1f.auth.BatchVerificationResponse\x12N\n\x11VerifyProofStream\x12\x19.auth.StreamVerifyRequest\x1a\x1a.auth.StreamVerifyResponse(\x010\x01b\x06proto3')
 
 _builder.BuildMessageAndEnumDescriptors(DESCRIPTOR, globals())
 _builder.BuildTopDescriptorsAndMessages(DESCRIPTOR, 'auth_pb2', globals())
-if _descriptor._USE_C_DESCRIPTORS == False:
-
-  DESCRIPTOR._options = None
-  _REGISTRATIONREQUEST._serialized_start=20
-  _REGISTRATIONREQUEST._serialized_end=82
-  _REGISTRATIONRESPONSE._serialized_start=84
-  _REGISTRATIONRESPONSE._serialized_end=140
-  _CHALLENGEREQUEST._serialized_start=142
-  _CHALLENGEREQUEST._serialized_end=177
-  _CHALLENGERESPONSE._serialized_start=179
-  _CHALLENGERESPONSE._serialized_end=240
-  _VERIFICATIONREQUEST._serialized_start=242
-  _VERIFICATIONREQUEST._serialized_end=317
-  _VERIFICATIONRESPONSE._serialized_start=319
-  _VERIFICATIONRESPONSE._serialized_end=421
-  _BATCHVERIFICATIONREQUEST._serialized_start=423
-  _BATCHVERIFICATIONREQUEST._serialized_end=506
-  _BATCHVERIFICATIONRESPONSE._serialized_start=508
-  _BATCHVERIFICATIONRESPONSE._serialized_end=578
-  _VERIFICATIONRESULT._serialized_start=580
-  _VERIFICATIONRESULT._serialized_end=680
-  _BATCHREGISTRATIONREQUEST._serialized_start=682
-  _BATCHREGISTRATIONREQUEST._serialized_end=764
-  _BATCHREGISTRATIONRESPONSE._serialized_start=766
-  _BATCHREGISTRATIONRESPONSE._serialized_end=836
-  _REGISTRATIONRESULT._serialized_start=838
-  _REGISTRATIONRESULT._serialized_end=892
-  _AUTHSERVICE._serialized_start=895
-  _AUTHSERVICE._serialized_end=1280
-# @@protoc_insertion_point(module_scope)

@@ -1,0 +1,28 @@
+"""Proof-log subsystem: streaming-adjacent audit surface for the batch
+engine (ROADMAP item 5).
+
+- :mod:`.log` — the append-only, CRC-framed proof log the service writes
+  behind ``[audit]`` (WAL framing discipline, own metrics namespace);
+- :mod:`.pipeline` — the bulk replay pipeline (``python -m
+  cpzk_tpu.audit run``): proof log -> batch engine at full device
+  quantum, resumable cursor, deterministic digest chain;
+- :mod:`.sign` — Schnorr-signed (ristretto255 + Merlin) audit reports
+  with a fully offline ``verify-report`` mode.
+"""
+
+from .log import ProofLogWriter, proof_record, read_log, scan_records
+from .pipeline import AuditState, run_audit, verify_report_file
+from .sign import load_or_create_key, sign_report, verify_report
+
+__all__ = [
+    "AuditState",
+    "ProofLogWriter",
+    "load_or_create_key",
+    "proof_record",
+    "read_log",
+    "run_audit",
+    "scan_records",
+    "sign_report",
+    "verify_report",
+    "verify_report_file",
+]

@@ -18,12 +18,13 @@ from __future__ import annotations
 
 import asyncio
 import random
+from dataclasses import dataclass
 
 import grpc
 
 from ..observability.context import RequestContext
 from ..resilience.retry import RETRY_PUSHBACK_KEY, RetryPolicy
-from ..server.proto import SERVICE_NAME, load_pb2, method_types
+from ..server.proto import SERVICE_NAME, load_pb2, method_types, stream_method_types
 
 #: RPCs safe to resend on a transient failure.  Register re-sent after an
 #: unreported success fails loudly with ALREADY_EXISTS (never silently
@@ -53,6 +54,21 @@ def _pushback_ms(err) -> float | None:
         except (TypeError, ValueError):
             return None
     return None
+
+
+@dataclass(slots=True)
+class StreamVerdict:
+    """One per-proof outcome from :meth:`AuthClient.verify_proof_stream`.
+
+    ``retry_after_ms`` nonzero marks an entry the server SHED under
+    admission pressure (not verified, not rejected) — resend it after the
+    delay; the stream itself stayed open."""
+
+    id: int
+    ok: bool
+    message: str
+    session_token: str | None = None
+    retry_after_ms: int = 0
 
 
 class AuthClient:
@@ -89,6 +105,13 @@ class AuthClient:
             )
             for name, (req, resp) in types.items()
         }
+        stream_types = stream_method_types(self.pb2)
+        req, resp = stream_types["VerifyProofStream"]
+        self._stream_stub = self.channel.stream_stream(
+            f"/{SERVICE_NAME}/VerifyProofStream",
+            request_serializer=req.SerializeToString,
+            response_deserializer=resp.FromString,
+        )
 
     async def close(self) -> None:
         await self.channel.close()
@@ -217,6 +240,127 @@ class AuthClient:
             ),
             timeout,
         )
+
+    async def verify_proof_stream(
+        self,
+        entries,
+        timeout: float | None = None,
+        mint_sessions: bool = False,
+        chunk: int = 512,
+    ):
+        """Stream proofs, get verdicts: an async iterator of
+        :class:`StreamVerdict` over the ``VerifyProofStream`` bidi RPC.
+
+        ``entries`` is a sync or async iterable of ``(user_id,
+        challenge_id, proof_bytes)`` tuples.  The client packs up to
+        ``chunk`` entries per wire message (amortizing HTTP/2 frame +
+        protobuf overhead — the knob that lets one stream keep a device
+        batch engine fed) and assigns sequential ids; verdicts stream
+        back in entry order as the server's device batches settle.
+
+        Never retried (same consumed-challenge semantics as
+        VerifyProof): a transport failure mid-stream surfaces
+        immediately — the caller restarts from CreateChallenge for
+        whatever entries had no verdict yet.
+
+        Convenience wrapper over :meth:`verify_proof_stream_chunks` —
+        bulk drivers that count outcomes at 10k+ proofs/s should consume
+        the chunk iterator directly and skip the per-entry object."""
+        async for chunk_v in self.verify_proof_stream_chunks(
+            entries, timeout=timeout, mint_sessions=mint_sessions,
+            chunk=chunk,
+        ):
+            ids, succ, msgs, tokens, push = chunk_v
+            n_tok = len(tokens)
+            n_msg = len(msgs)
+            for k in range(len(ids)):
+                ok = succ[k]
+                yield StreamVerdict(
+                    id=ids[k],
+                    ok=ok,
+                    message=msgs[k] if k < n_msg else "",
+                    session_token=(
+                        tokens[k] if k < n_tok and tokens[k] else None
+                    ),
+                    retry_after_ms=0 if ok else push,
+                )
+
+    async def verify_proof_stream_chunks(
+        self,
+        entries,
+        timeout: float | None = None,
+        mint_sessions: bool = False,
+        chunk: int = 512,
+    ):
+        """The raw chunk-level face of :meth:`verify_proof_stream`:
+        yields ``(ids, success, messages, session_tokens,
+        retry_after_ms)`` — plain lists materialized once per response
+        message — in entry order.  This is the surface bulk pipelines
+        and the e2e bench drive: per-verdict Python objects are the
+        client's dominant cost at device-batch rates."""
+        rctx = RequestContext()
+        self.last_context = rctx
+        call = self._stream_stub(
+            timeout=timeout, metadata=self._metadata(rctx)
+        )
+
+        async def _aiter(items):
+            if hasattr(items, "__aiter__"):
+                async for item in items:
+                    yield item
+            else:
+                for item in items:
+                    yield item
+
+        async def _writer():
+            next_id = 0
+            ids, users, cids, proofs = [], [], [], []
+
+            async def _flush():
+                nonlocal ids, users, cids, proofs
+                await call.write(self.pb2.StreamVerifyRequest(
+                    ids=ids, user_ids=users, challenge_ids=cids,
+                    proofs=proofs, mint_sessions=mint_sessions,
+                ))
+                ids, users, cids, proofs = [], [], [], []
+
+            async for user_id, challenge_id, proof in _aiter(entries):
+                ids.append(next_id)
+                next_id += 1
+                users.append(user_id)
+                cids.append(bytes(challenge_id))
+                proofs.append(bytes(proof))
+                if len(ids) >= max(1, chunk):
+                    await _flush()
+            if ids:
+                await _flush()
+            await call.done_writing()
+
+        writer = asyncio.ensure_future(_writer())
+        try:
+            async for resp in call:
+                # bulk repeated-field materialization (one C call each)
+                # instead of per-index proto __getitem__ in a hot loop
+                yield (
+                    list(resp.ids),
+                    list(resp.success),
+                    list(resp.messages),
+                    list(resp.session_tokens),
+                    int(getattr(resp, "retry_after_ms", 0) or 0),
+                )
+            await writer
+        finally:
+            if not writer.done():
+                writer.cancel()
+                await asyncio.gather(writer, return_exceptions=True)
+            # abandoned mid-iteration (caller broke out of the loop):
+            # cancel the RPC so the server tears the stream down instead
+            # of waiting on a reader that will never come back
+            try:
+                if not call.done():
+                    call.cancel()
+            except Exception:  # pragma: no cover - non-grpc call stub
+                pass
 
     async def health_check(
         self, timeout: float | None = None, service: str = ""

@@ -89,7 +89,9 @@ def encode_record(rec: dict) -> bytes:
     return _HEADER.pack(len(payload), zlib.crc32(payload) & 0xFFFFFFFF) + payload
 
 
-def iter_frames(buf: bytes) -> tuple[list[dict], int]:
+def iter_frames(
+    buf: bytes, offset: int = 0, prev_seq: int | None = None
+) -> tuple[list[dict], int]:
     """``(records, valid_bytes)``: the longest well-formed prefix of ``buf``.
 
     Stops at the first short header, oversized/zero length field, CRC
@@ -97,11 +99,15 @@ def iter_frames(buf: bytes) -> tuple[list[dict], int]:
     or non-increasing sequence number.  ``valid_bytes`` is the byte offset
     the file should be truncated to; everything past it is a torn tail or
     corruption and is never surfaced as a record.
+
+    ``offset``/``prev_seq`` resume a previous scan mid-file (the audit
+    pipeline's cursor): parsing starts at ``offset`` and the first record's
+    sequence number must exceed ``prev_seq`` — byte-identical results to
+    one whole-buffer scan split at any frame boundary.
     """
     out: list[dict] = []
-    off = 0
+    off = offset
     n = len(buf)
-    prev_seq = None
     while n - off >= HEADER_BYTES:
         length, crc = _HEADER.unpack_from(buf, off)
         if length == 0 or length > MAX_FRAME_PAYLOAD:

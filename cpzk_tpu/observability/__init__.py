@@ -28,7 +28,7 @@ from .flightrec import (
     format_flightrec,
     get_flight_recorder,
 )
-from .instrument import rpc_deadline, traced_rpc
+from .instrument import rpc_deadline, traced_rpc, traced_stream_rpc
 from .logs import JsonLogFormatter, enable_json_logs
 from .tracing import (
     BatchStages,
@@ -60,6 +60,7 @@ __all__ = [
     "new_trace_id",
     "rpc_deadline",
     "traced_rpc",
+    "traced_stream_rpc",
 ]
 
 

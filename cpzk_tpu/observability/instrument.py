@@ -46,6 +46,69 @@ def rpc_deadline(context) -> float | None:
     return time.monotonic() + max(0.0, remaining)
 
 
+def traced_stream_rpc(rpc: str, metric_prefix: str):
+    """The :func:`traced_rpc` lifecycle for an async-generator
+    ``(self, request_iterator, context)`` bidi-streaming handler: one
+    trace per STREAM (entries inherit its trace id through the batcher,
+    so their stage spans land on the stream's trace exactly like a unary
+    request's do), requests/outcome counters and a duration histogram
+    over the stream's whole life, and the slow-request WARNING keyed on
+    stream duration."""
+
+    def decorator(fn):
+        @functools.wraps(fn)
+        async def wrapper(self, request_iterator, context):
+            rctx = RequestContext.from_grpc(
+                context, deadline=rpc_deadline(context)
+            )
+            token = current_context.set(rctx)
+            tracer = get_tracer()
+            tracer.start(rctx, rpc)
+            metrics.counter(f"{metric_prefix}.requests").inc()
+            start = time.perf_counter()
+            outcome = "failure"
+            try:
+                async for response in fn(self, request_iterator, context):
+                    yield response
+                outcome = "success"
+            finally:
+                duration = time.perf_counter() - start
+                metrics.counter(f"{metric_prefix}.{outcome}").inc()
+                metrics.histogram(f"{metric_prefix}.duration").observe(duration)
+                metrics.counter(
+                    "rpc.requests", labelnames=("rpc", "outcome")
+                ).labels(rpc=rpc, outcome=outcome).inc()
+                metrics.histogram(
+                    "rpc.duration", labelnames=("rpc",)
+                ).labels(rpc=rpc).observe(duration)
+                record = tracer.finish(
+                    rctx.trace_id, outcome, duration_s=duration
+                )
+                threshold = tracer.slow_request_s
+                if threshold is not None and duration >= threshold:
+                    stages = {
+                        s.name: round(s.duration_s * 1000, 3)
+                        for s in (record.spans if record else ())
+                    }
+                    rpc_log.warning(
+                        "%s %s in %.2fms (attempt %d)",
+                        rpc, outcome, duration * 1000, rctx.attempt,
+                        extra={
+                            "trace_id": rctx.trace_id,
+                            "rpc": rpc,
+                            "outcome": outcome,
+                            "duration_ms": round(duration * 1000, 3),
+                            "attempt": rctx.attempt,
+                            "stages_ms": stages,
+                        },
+                    )
+                current_context.reset(token)
+
+        return wrapper
+
+    return decorator
+
+
 def traced_rpc(rpc: str, metric_prefix: str):
     """Wrap an async ``(self, request, context)`` RPC handler with the
     full metrics + tracing lifecycle described in the module docstring."""

@@ -285,7 +285,11 @@ class BatchStages:
         queue_wait_s: float = 0.0,
     ):
         self.tracer = tracer
-        self.trace_ids = [t for t in trace_ids if t]
+        # deduped (order kept): a batch whose entries share one trace —
+        # a VerifyProofBatch's items, or a whole VerifyProofStream chunk —
+        # must get ONE span per stage on that trace, not one per entry
+        # (64k-entry streams would append 64k identical spans per stage)
+        self.trace_ids = list(dict.fromkeys(t for t in trace_ids if t))
         self.batch_size = batch_size
         self.backend_label = backend_label
         self.queue_wait_s = queue_wait_s

@@ -183,6 +183,7 @@ HELP = """Available commands:
                       thread_hop/marshal/compile/execute split, jit hits
   /profile S [DIR]    capture S seconds of jax.profiler (xprof) trace
   /persist     (/wal) durability status: WAL size, fsync age, covered seq
+  /audit       (/au)  proof-log status: path, bytes, seq, pending appends
   /replication (/repl) replication status: role, epoch, lag, lease
   /promote            promote this standby to primary (operator failover)
   /users       (/u)   registered user count
@@ -196,7 +197,7 @@ HELP = """Available commands:
 
 async def handle_command(
     cmd: str, state: ServerState, backend=None, durability=None,
-    admission=None, replication=None,
+    admission=None, replication=None, audit_log=None,
 ) -> tuple[str, bool]:
     """(output, should_quit) for one REPL line (server.rs:50-90,261-359).
     ``backend`` is the serving FailoverBackend (None on the inline CPU
@@ -205,7 +206,9 @@ async def handle_command(
     durability is disabled); ``admission`` is the AdmissionController
     behind /overload (None when admission is disabled); ``replication``
     is the SegmentShipper (primary) or StandbyReplica (standby) behind
-    /replication and /promote (None when replication is disabled)."""
+    /replication and /promote (None when replication is disabled);
+    ``audit_log`` is the ProofLogWriter behind /audit (None when the
+    audit trail is disabled)."""
     cmd = cmd.strip()
     if not cmd:
         return "", False
@@ -316,6 +319,22 @@ async def handle_command(
             f" fsync={s['fsync_policy']}"
             f" last_fsync_age={s['last_fsync_age_s']:.1f}s"
             f" snapshot_age={'n/a' if age is None else f'{age:.1f}s'}",
+            False,
+        )
+    if word in ("/audit", "/au"):
+        if audit_log is None:
+            return (
+                "audit trail disabled (set [audit] enabled = true and a "
+                "log_path to record verified proofs for offline replay)",
+                False,
+            )
+        s = audit_log.status()
+        return (
+            f"log={s['path']} bytes={s['bytes']} seq={s['seq']}"
+            f" this_boot={s['records_this_boot']}"
+            f" pending={s['pending_appends']} fsync={s['fsync_policy']}"
+            f" — replay with: python -m cpzk_tpu.audit run --log"
+            f" {s['path']} --report <out.json>",
             False,
         )
     if word in ("/replication", "/repl"):
@@ -539,6 +558,20 @@ async def amain(args) -> None:
                 config.replication.renew_interval_ms,
             )
 
+    audit_log = None
+    if config.audit.enabled:
+        from ..audit import ProofLogWriter
+
+        audit_log = ProofLogWriter(
+            config.audit.log_path,
+            fsync=config.audit.fsync,
+            fsync_interval_ms=config.audit.fsync_interval_ms,
+        )
+        log.info(
+            "audit trail enabled: proof log at %s (fsync=%s, seq=%d)",
+            config.audit.log_path, config.audit.fsync, audit_log.seq,
+        )
+
     # started after the replication block: an unpromoted standby's sweep
     # must checkpoint-only (see cleanup_supervisor)
     cleanup_task = asyncio.create_task(
@@ -550,7 +583,9 @@ async def amain(args) -> None:
     server, port = await serve(
         state, limiter, host=config.host, port=config.port,
         backend=backend, batcher=batcher, tls=tls, admission=admission,
-        replica=replica,
+        replica=replica, audit_log=audit_log,
+        stream_window=config.tpu.stream_window,
+        stream_entry_deadline_ms=config.tpu.stream_entry_deadline_ms,
     )
     if shipper is not None:
         shipper.start()
@@ -590,7 +625,7 @@ async def amain(args) -> None:
                 return
             out, quit_ = await handle_command(
                 line, state, backend, durability, admission,
-                shipper or replica,
+                shipper or replica, audit_log,
             )
             if out:
                 print(_c("white", out))
@@ -613,6 +648,10 @@ async def amain(args) -> None:
     await asyncio.sleep(DRAIN_SECONDS)
     if batcher is not None:
         await batcher.stop()  # drain queued verifications before the listener
+    if audit_log is not None:
+        # after the batcher drain: the last verdicts' records are appended
+        await asyncio.to_thread(audit_log.close)
+        log.info("audit trail closed at seq %d", audit_log.seq)
     if shipper is not None:
         await shipper.stop()  # one final flush tick toward the standby
     if replica is not None:

@@ -82,6 +82,13 @@ class TpuSettings:
                                     # self-heal, degrade until /reset)
     probe_batch_max: int = 64     # rows re-verified on the TPU per probe
     shed_expired: bool = True     # drop deadline-expired queue entries
+    stream_window: int = 8192     # max in-flight proofs per
+                                  # VerifyProofStream before the reader
+                                  # stops pulling (gRPC flow control then
+                                  # pushes back on the sender)
+    stream_entry_deadline_ms: float = 0.0  # per-entry verify deadline on
+                                  # streams; 0 = only the stream's own
+                                  # gRPC deadline applies
 
 
 @dataclass
@@ -159,6 +166,23 @@ class ReplicationSettings:
 
 
 @dataclass
+class AuditSettings:
+    """Proof-log audit trail (audit subsystem): when enabled, the service
+    appends one CRC-framed record per verified proof (statement,
+    challenge, proof wire, verdict) to ``log_path``; ``python -m
+    cpzk_tpu.audit run`` later replays it through the batch engine and
+    emits a Schnorr-signed report.  See ``docs/operations.md``
+    §"Streaming & audit"."""
+
+    enabled: bool = False         # opt-in; requires log_path
+    log_path: str = ""            # the proof log (created 0600)
+    fsync: str = "off"            # "always" | "interval" | "off" — an
+                                  # audit trail usually tolerates losing
+                                  # the last instants of a crash
+    fsync_interval_ms: float = 200.0  # cadence under the interval policy
+
+
+@dataclass
 class AdmissionSettings:
     """Adaptive overload control (admission subsystem): per-client keyed
     token buckets in an LRU-bounded table, DAGOR-style priority-aware
@@ -231,6 +255,7 @@ class ServerConfig:
     replication: ReplicationSettings = field(
         default_factory=ReplicationSettings
     )
+    audit: AuditSettings = field(default_factory=AuditSettings)
 
     def addr(self) -> str:
         return f"{self.host}:{self.port}"
@@ -265,6 +290,7 @@ class ServerConfig:
             ("observability", self.observability),
             ("durability", self.durability),
             ("replication", self.replication),
+            ("audit", self.audit),
         ):
             for key, value in data.get(section, {}).items():
                 if hasattr(obj, key):
@@ -356,6 +382,10 @@ class ServerConfig:
             self.tpu.shed_expired = v.lower() in ("1", "true", "yes", "on")
         if (v := get("TPU_PREWARM_QUANTA")) is not None:
             self.tpu.prewarm_quanta = v
+        if (v := get("TPU_STREAM_WINDOW")) is not None:
+            self.tpu.stream_window = int(v)
+        if (v := get("TPU_STREAM_ENTRY_DEADLINE_MS")) is not None:
+            self.tpu.stream_entry_deadline_ms = float(v)
         if (v := get("RETRY_MAX_ATTEMPTS")) is not None:
             self.retry.max_attempts = int(v)
         if (v := get("RETRY_INITIAL_BACKOFF_MS")) is not None:
@@ -415,6 +445,15 @@ class ServerConfig:
             self.replication.epoch_file = v
         if (v := get("REPLICATION_SHARDS")) is not None:
             self.replication.shards = int(v)
+        # audit knobs (proof-log trail behind the bulk audit pipeline)
+        if (v := get("AUDIT_ENABLED")) is not None:
+            self.audit.enabled = v.lower() in ("1", "true", "yes", "on")
+        if (v := get("AUDIT_LOG_PATH")) is not None:
+            self.audit.log_path = v
+        if (v := get("AUDIT_FSYNC")) is not None:
+            self.audit.fsync = v.lower()
+        if (v := get("AUDIT_FSYNC_INTERVAL_MS")) is not None:
+            self.audit.fsync_interval_ms = float(v)
 
     # --- validation (config.rs:238-273) ---
 
@@ -486,6 +525,13 @@ class ServerConfig:
             )
         if self.tpu.probe_batch_max < 1:
             raise ValueError("tpu.probe_batch_max must be positive")
+        if self.tpu.stream_window < 1:
+            raise ValueError("tpu.stream_window must be positive")
+        if self.tpu.stream_entry_deadline_ms < 0:
+            raise ValueError(
+                "tpu.stream_entry_deadline_ms cannot be negative "
+                "(0 disables per-entry deadlines)"
+            )
         try:
             quanta = self.tpu.parsed_prewarm_quanta()
         except ValueError:
@@ -568,6 +614,17 @@ class ServerConfig:
                     "replication on the primary requires peer (the "
                     "standby's gRPC address)"
                 )
+        if self.audit.fsync not in ("always", "interval", "off"):
+            raise ValueError(
+                "audit.fsync must be one of: always, interval, off"
+            )
+        if self.audit.fsync_interval_ms <= 0:
+            raise ValueError("audit.fsync_interval_ms must be positive")
+        if self.audit.enabled and not self.audit.log_path:
+            raise ValueError(
+                "audit.enabled requires log_path (where the proof log "
+                "is appended)"
+            )
         try:
             buckets = self.observability.parsed_buckets()
         except ValueError:

@@ -29,6 +29,12 @@ _METHODS = {
     "VerifyProofBatch": ("BatchVerificationRequest", "BatchVerificationResponse"),
 }
 
+#: Bidirectional-streaming RPCs (wired via stream_stream handlers, kept
+#: out of ``_METHODS`` so the unary stub/handler loops stay unchanged).
+_STREAM_METHODS = {
+    "VerifyProofStream": ("StreamVerifyRequest", "StreamVerifyResponse"),
+}
+
 
 def _generate(name: str) -> None:
     os.makedirs(_GEN_DIR, exist_ok=True)
@@ -71,8 +77,17 @@ def load_replication_pb2():
 
 
 def method_types(pb2):
-    """{rpc name: (request class, response class)} for all five RPCs."""
+    """{rpc name: (request class, response class)} for the unary RPCs."""
     return {
         name: (getattr(pb2, req), getattr(pb2, resp))
         for name, (req, resp) in _METHODS.items()
+    }
+
+
+def stream_method_types(pb2):
+    """{rpc name: (request class, response class)} for the bidi-streaming
+    RPCs (``VerifyProofStream``)."""
+    return {
+        name: (getattr(pb2, req), getattr(pb2, resp))
+        for name, (req, resp) in _STREAM_METHODS.items()
     }

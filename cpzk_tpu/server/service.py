@@ -16,18 +16,22 @@ import time
 
 import grpc
 
+from dataclasses import dataclass, field
+
 from .. import errors
 from ..admission import RETRY_PUSHBACK_KEY, client_key
+from ..audit.log import proof_record
 from ..core.ristretto import Ristretto255
 from ..core.rng import SecureRng
 from ..core.transcript import Transcript
-from ..observability import current_context, traced_rpc
-from ..protocol.batch import BatchVerifier, VerifierBackend
+from ..observability import current_context, traced_rpc, traced_stream_rpc
+from ..protocol.batch import BatchEntry, BatchVerifier, VerifierBackend
 from ..protocol.gadgets import Parameters, Proof, Statement
 from ..protocol.verifier import Verifier
 from . import batching, metrics
 from .config import RateLimiter, RateLimitExceeded
-from .proto import SERVICE_NAME, load_pb2, method_types
+from .dispatch import DispatchLane
+from .proto import SERVICE_NAME, load_pb2, method_types, stream_method_types
 from .state import ServerState, UserData
 from .state import user_id_error as _user_id_error
 
@@ -36,6 +40,13 @@ MAX_CHALLENGE_ID = 64
 MAX_PROOF_WIRE = 8192
 MAX_BATCH = 1000
 
+#: Hard cap on entries per stream chunk message: a client packing more is
+#: answered with per-entry failures, never a bigger allocation.
+MAX_STREAM_CHUNK = 4096
+
+#: "no verdict recorded" sentinel for a stream entry's result slot.
+_UNSET = object()
+
 #: Pushback advertised on RESOURCE_EXHAUSTED paths that have no better
 #: estimate (no admission controller / no queue signal): one client
 #: backoff's worth, so uninstrumented retry loops still spread out.
@@ -43,7 +54,8 @@ DEFAULT_RETRY_AFTER_S = 0.05
 
 
 class AuthServiceImpl:
-    """The five RPCs (service.rs:59-617 twin)."""
+    """The five unary RPCs (service.rs:59-617 twin) plus the
+    ``VerifyProofStream`` bidi-streaming verification surface."""
 
     def __init__(
         self,
@@ -53,6 +65,9 @@ class AuthServiceImpl:
         batcher=None,
         admission=None,
         replica=None,
+        audit_log=None,
+        stream_window: int = 8192,
+        stream_entry_deadline_ms: float = 0.0,
     ):
         self.state = state
         self.rate_limiter = rate_limiter
@@ -60,6 +75,18 @@ class AuthServiceImpl:
         self.batcher = batcher  # DynamicBatcher | None (TPU serving path)
         self.admission = admission  # AdmissionController | None
         self.replica = replica  # StandbyReplica | None (replication standby)
+        self.audit_log = audit_log  # audit.ProofLogWriter | None (opt-in)
+        #: max proof entries in flight per VerifyProofStream before the
+        #: reader stops pulling (gRPC flow control then pushes back on the
+        #: sender) — bounds per-stream memory without killing the stream
+        self.stream_window = max(1, int(stream_window))
+        #: per-entry verification deadline for stream entries (0 = only
+        #: the stream's own gRPC deadline applies); expired entries are
+        #: shed by the batcher and answered with per-entry NOT-verdicts
+        self.stream_entry_deadline_s = (
+            stream_entry_deadline_ms / 1000.0
+            if stream_entry_deadline_ms > 0 else None
+        )
         self.pb2 = load_pb2()
         self.rng = SecureRng()
         # inline-verify concurrency: 2 lets one RPC's Python overlap
@@ -67,6 +94,9 @@ class AuthServiceImpl:
         # workers each spawning a cpu-wide native pool (crypto-vs-crypto
         # oversubscription under many concurrent batch RPCs)
         self._inline_verify = asyncio.Semaphore(2)
+        # in-flight audit-log fsync tasks (handles kept: a dropped task
+        # handle both leaks exceptions and trips ASYNC-002)
+        self._audit_flushes: set[asyncio.Task] = set()
 
     # --- helpers ---
 
@@ -138,6 +168,35 @@ class AuthServiceImpl:
                 context, deadline=rpc_deadline(context)
             )
         return rctx
+
+    def _audit_note(
+        self, items: list[tuple[str, Statement, bytes, bytes, bool]]
+    ) -> None:
+        """Append verification outcomes to the proof log (no-op unless
+        ``[audit]`` wired one in).  ``items``: (user_id, statement,
+        challenge_id, proof_wire, verdict) per VERIFIED entry — shed or
+        errored entries never reached the verifier and are not audit
+        events.  The append is one buffered ``os.write``; the fsync (when
+        the policy wants one) runs on a worker thread with its task
+        handle retained."""
+        log = self.audit_log
+        if log is None or not items:
+            return
+        eb = Ristretto255.element_to_bytes
+        try:
+            log.append_proofs([
+                proof_record(uid, eb(st.y1), eb(st.y2), ctx, wire, ok)
+                for uid, st, ctx, wire, ok in items
+            ])
+        except OSError:
+            metrics.counter("audit.log.errors").inc()
+            return
+        if log.needs_sync():
+            task = asyncio.get_running_loop().create_task(
+                asyncio.to_thread(log.sync)
+            )
+            self._audit_flushes.add(task)
+            task.add_done_callback(self._audit_flushes.discard)
 
     def _parse_statement(self, y1_bytes: bytes, y2_bytes: bytes) -> Statement:
         """Shared register-path statement validation; raises errors.Error
@@ -339,6 +398,12 @@ class AuthServiceImpl:
                 verify_err = None
             except errors.Error as e:
                 verify_err = e
+        # audit trail BEFORE the failure abort: rejected proofs are audit
+        # events too (the bulk pipeline re-checks both verdicts)
+        self._audit_note([(
+            request.user_id, user.statement, bytes(request.challenge_id),
+            bytes(request.proof), verify_err is None,
+        )])
         if verify_err is not None:
             await context.abort(
                 grpc.StatusCode.PERMISSION_DENIED, f"Verification failed: {verify_err}"
@@ -386,6 +451,7 @@ class AuthServiceImpl:
 
         batch = BatchVerifier(backend=self.backend)
         contexts: list[str | None] = []  # user_id once queued for verify, else None
+        statements: dict[int, Statement] = {}  # queued-for-verify audit trail
         error_msgs: list[str] = []
         # stage 1: argument validation (no awaits)
         staged: list[int] = []  # indices that passed arg validation
@@ -441,6 +507,7 @@ class AuthServiceImpl:
                 error_msgs[i] = f"Failed to add proof to batch: {e}"
                 continue
             contexts[i] = user_ids[i]
+            statements[i] = user.statement
 
         batch_results: list = []
         if len(batch) > 0:
@@ -482,6 +549,7 @@ class AuthServiceImpl:
         tokens: dict[int, str] = {}
         batch_index = 0
         verify_errs: dict[int, object] = {}
+        audit_items = []
         for i in range(n):
             if contexts[i] is None:
                 continue
@@ -489,6 +557,11 @@ class AuthServiceImpl:
             batch_index += 1
             if verify_errs[i] is None:
                 verified.append(i)
+            audit_items.append((
+                contexts[i], statements[i], bytes(challenge_ids[i]),
+                bytes(proof_wires[i]), verify_errs[i] is None,
+            ))
+        self._audit_note(audit_items)
         token_pool = self.rng.fill_bytes(32 * len(verified)).hex()
         for k, i in enumerate(verified):
             tokens[i] = self.state.tag_session_token(
@@ -538,6 +611,359 @@ class AuthServiceImpl:
 
         return self.pb2.BatchVerificationResponse(results=results)
 
+    # --- streaming verification -------------------------------------------
+
+    @traced_stream_rpc("VerifyProofStream", "auth.verify_stream")
+    async def verify_proof_stream(self, request_iterator, context):
+        """Bidirectional streaming verification: the client streams proof
+        entries (possibly several per message — parallel arrays keyed by
+        ``ids``), the server streams verdicts as their device batches
+        settle.  Entries enqueue straight into the dynamic batcher, so
+        one stream gives the dispatch lane naturally deep, TPU-sized
+        batches without per-RPC overhead.
+
+        Contract highlights (pinned in ``tests/test_streaming.py``):
+
+        - **flow control**: at most ``stream_window`` entries in flight;
+          past it the reader stops pulling and gRPC's own flow control
+          pushes back on the sender — memory stays bounded, the stream
+          stays open;
+        - **admission per proof, not per RPC**: the keyed token bucket is
+          charged for every entry (client id read once at stream open);
+          a shed entry answers a per-entry NOT-verdict with the pushback
+          delay in ``retry_after_ms`` (and trailing metadata at stream
+          end) — the stream is never killed for overload;
+        - **per-entry deadline shedding**: expired entries come back as
+          NOT-verdicts while their batch siblings carry real verdicts;
+        - **failure isolation**: a backend blow-up is confined to its
+          chunk (NOT-verdicts), the stream and the lane both survive;
+        - **verdict order** follows entry order.
+        """
+        if self.replica is not None and self.replica.role != "primary":
+            await context.abort(
+                grpc.StatusCode.UNAVAILABLE,
+                "standby replica: not promoted (writes go to the primary)",
+            )
+        # the global bucket is an RPC-level backstop: one charge per
+        # stream open; per-PROOF fairness is the keyed bucket below
+        try:
+            await self.rate_limiter.check_rate_limit()
+        except RateLimitExceeded as e:
+            metrics.counter("admission.shed.global").inc()
+            await self._abort_exhausted(
+                context, "Rate limit exceeded",
+                getattr(e, "retry_after_s", 0.0) or DEFAULT_RETRY_AFTER_S,
+            )
+        client = client_key(context)  # read once at stream open
+        rctx = self._request_context(context)
+        pushback_ms = 0
+
+        def note_pushback(ms: int) -> None:
+            nonlocal pushback_ms
+            pushback_ms = max(pushback_ms, ms)
+
+        # reader task + responder loop: chunks dispatch the moment they
+        # arrive and verdicts flow back the moment their batches settle —
+        # a client that reads verdicts before sending its next chunk must
+        # never deadlock against a handler that only flushes on pressure.
+        # The window condition is the flow-control seam: past
+        # ``stream_window`` in-flight entries the reader stops pulling,
+        # and gRPC's transport-level flow control pushes back on the
+        # sender without killing the stream.
+        cond = asyncio.Condition()
+        inflight = 0
+        unsettled: set[_StreamChunk] = set()
+        out_q: asyncio.Queue[_StreamChunk | None] = asyncio.Queue()
+
+        async def reader() -> None:
+            nonlocal inflight
+            try:
+                async for request in request_iterator:
+                    async with cond:
+                        while inflight > self.stream_window:
+                            await cond.wait()
+                    work = self._stream_start_chunk(
+                        request, client, rctx, note_pushback
+                    )
+                    inflight += work.size
+                    unsettled.add(work)
+                    out_q.put_nowait(work)
+            finally:
+                out_q.put_nowait(None)
+
+        reader_task = asyncio.get_running_loop().create_task(reader())
+        try:
+            while True:
+                work = await out_q.get()
+                if work is None:
+                    break
+                resp = await self._stream_settle(work)
+                unsettled.discard(work)
+                async with cond:
+                    inflight -= work.size
+                    cond.notify_all()
+                yield resp
+            await reader_task  # surface a reader-side transport error
+        finally:
+            # client gone / handler torn down with chunks in flight:
+            # cancel the reader and every unsettled verify task so no
+            # batcher future leaks (cancelled chunk futures are shed as
+            # 'abandoned' before device dispatch)
+            if not reader_task.done():
+                reader_task.cancel()
+            doomed = [w.task for w in unsettled if w.task is not None]
+            for task in doomed:
+                task.cancel()
+            if doomed or not reader_task.done():
+                await asyncio.gather(
+                    reader_task, *doomed, return_exceptions=True,
+                )
+            if pushback_ms > 0:
+                try:
+                    context.set_trailing_metadata(
+                        ((RETRY_PUSHBACK_KEY, str(pushback_ms)),)
+                    )
+                except Exception:  # hand-rolled test context
+                    pass
+
+    def _stream_start_chunk(
+        self, request, client: str, rctx, note_pushback
+    ) -> "_StreamChunk":
+        """Validate + admit one chunk message, consume its challenges,
+        and dispatch the survivors into the batcher WITHOUT awaiting —
+        the caller keeps reading while the device works."""
+        ids = list(request.ids)
+        n = len(ids)
+        work = _StreamChunk(ids=ids, size=max(n, 1),
+                            mint=bool(request.mint_sessions))
+        if (
+            n == 0
+            or n != len(request.user_ids)
+            or n != len(request.challenge_ids)
+            or n != len(request.proofs)
+        ):
+            work.chunk_error = (
+                "Mismatched array lengths in stream chunk"
+                if n else "Empty stream chunk"
+            )
+            return work
+        if n > MAX_STREAM_CHUNK:
+            work.chunk_error = (
+                f"Stream chunk exceeds maximum of {MAX_STREAM_CHUNK} entries"
+            )
+            return work
+        metrics.counter("auth.stream.proofs_count").inc(n)
+        user_ids = list(request.user_ids)
+        challenge_ids = list(request.challenge_ids)
+        proof_wires = list(request.proofs)
+        work.messages = [""] * n
+        work.results = [_UNSET] * n
+        work.user_ids = user_ids
+        work.challenge_ids = challenge_ids
+        work.proof_wires = proof_wires
+        staged: list[int] = []
+        uid_memo: dict[str, str | None] = {}  # streams repeat user ids
+        for i in range(n):
+            # keyed fair admission charged per PROOF (satellite contract):
+            # a hot streamer exhausts its own bucket entry by entry and
+            # gets NOT-verdicts + pushback, never a dead stream
+            if self.admission is not None:
+                rejection = self.admission.admit("VerifyProof", client)
+                if rejection is not None:
+                    ms = max(0, int(round(rejection.retry_after_s * 1000.0)))
+                    note_pushback(ms)
+                    work.messages[i] = rejection.message
+                    work.shed[i] = ms
+                    metrics.counter("auth.stream.shed").inc()
+                    continue
+            uid = user_ids[i]
+            if uid in uid_memo:
+                msg = uid_memo[uid]
+            else:
+                msg = uid_memo[uid] = _user_id_error(uid)
+            msg = msg or _proof_args_error(challenge_ids[i], proof_wires[i])
+            if msg is not None:
+                work.messages[i] = msg
+                continue
+            staged.append(i)
+        work.staged = staged
+        if staged:
+            work.task = asyncio.get_running_loop().create_task(
+                self._stream_verify(work, rctx)
+            )
+        return work
+
+    async def _stream_verify(self, work: "_StreamChunk", rctx) -> None:
+        """One chunk's consume -> lookup -> parse -> dispatch, recording
+        per-entry outcomes onto ``work`` (runs as a task so the stream
+        reader is never blocked on the device)."""
+        staged = work.staged
+        challenges = await self.state.consume_challenges(
+            [work.challenge_ids[i] for i in staged])
+        users = await self.state.get_users(
+            [work.user_ids[i] for i in staged])
+        live: list[int] = []
+        for i, challenge, user in zip(staged, challenges, users, strict=True):
+            if (
+                challenge is None
+                or challenge.user_id != work.user_ids[i]
+                or user is None
+            ):
+                work.messages[i] = "Authentication failed"
+                continue
+            work.users[i] = user
+            live.append(i)
+        parsed = Proof.from_bytes_batch(
+            [work.proof_wires[i] for i in live],
+            defer_point_validation=True,
+        )
+        params = Parameters.new()
+        deadline = rctx.deadline
+        if self.stream_entry_deadline_s is not None:
+            entry_deadline = time.monotonic() + self.stream_entry_deadline_s
+            deadline = (
+                entry_deadline if deadline is None
+                else min(deadline, entry_deadline)
+            )
+        entries: list[BatchEntry] = []
+        queued: list[int] = []
+        for i, proof in zip(live, parsed, strict=True):
+            if isinstance(proof, errors.Error):
+                work.messages[i] = f"Invalid proof: {proof}"
+                continue
+            entries.append(BatchEntry(
+                params, work.users[i].statement, proof,
+                bytes(work.challenge_ids[i]),
+                deadline=deadline, trace_id=rctx.trace_id,
+            ))
+            queued.append(i)
+        if not entries:
+            return
+        if self.batcher is not None:
+            try:
+                results = await self.batcher.submit_group(entries)
+            except batching.QueueFull:
+                results = [batching.QueueFull("Server overloaded")] * len(entries)
+        else:
+            # inline CPU path: same dispatch seam, worker thread, bounded
+            # crypto concurrency (GIL-released native verify)
+            async with self._inline_verify:
+                try:
+                    results = await asyncio.to_thread(
+                        DispatchLane.verify_once,
+                        self.backend, self.rng, entries,
+                    )
+                except errors.Error as exc:
+                    results = [exc] * len(entries)
+        for i, res in zip(queued, results, strict=True):
+            work.results[i] = res
+
+    async def _stream_settle(self, work: "_StreamChunk"):
+        """Await a chunk's verification task and build its verdict
+        message (sessions minted in bulk, audit records appended)."""
+        Resp = self.pb2.StreamVerifyResponse
+        if work.chunk_error is not None:
+            return Resp(
+                ids=work.ids,
+                success=[False] * len(work.ids),
+                messages=[work.chunk_error] * len(work.ids),
+            )
+        if work.task is not None:
+            try:
+                await work.task
+            except asyncio.CancelledError:
+                raise
+            except Exception as exc:  # chunk-confined: stream survives
+                blowup = RuntimeError(f"Batch verification failed: {exc}")
+                for i in work.staged:
+                    if work.results[i] is _UNSET and work.users.get(i) is not None:
+                        work.results[i] = blowup
+        n = len(work.ids)
+        success = [False] * n
+        audit_items = []
+        verified: list[int] = []
+        retry_ms = 0
+        shed = work.shed
+        results = work.results
+        for i in range(n):
+            if shed and i in shed:
+                retry_ms = max(retry_ms, shed[i])
+                continue
+            res = results[i]
+            if res is _UNSET:
+                continue  # message already set (validation/auth failure)
+            if res is None:
+                success[i] = True
+                verified.append(i)
+            elif isinstance(res, batching.DeadlineExceeded):
+                work.messages[i] = "Deadline expired before verification"
+                metrics.counter("auth.stream.shed").inc()
+            elif isinstance(res, batching.QueueFull):
+                work.messages[i] = "Server overloaded"
+                ms = max(0, int(round(self._pushback_s() * 1000.0)))
+                retry_ms = max(retry_ms, ms)
+                metrics.counter("auth.stream.shed").inc()
+            elif isinstance(res, errors.InvalidProofEncoding):
+                work.messages[i] = f"Invalid proof: {res}"
+            elif isinstance(res, errors.Error):
+                work.messages[i] = "Authentication failed"
+            else:  # dispatch blow-up (backend raise) confined to chunk
+                work.messages[i] = "Verification unavailable"
+            if isinstance(res, (type(None), errors.Error)):
+                user = work.users.get(i)
+                if user is not None:
+                    audit_items.append((
+                        work.user_ids[i], user.statement,
+                        bytes(work.challenge_ids[i]),
+                        bytes(work.proof_wires[i]), res is None,
+                    ))
+        self._audit_note(audit_items)
+        tokens: dict[int, str] = {}
+        if work.mint and verified:
+            pool = self.rng.fill_bytes(32 * len(verified)).hex()
+            pairs = []
+            for k, i in enumerate(verified):
+                tokens[i] = self.state.tag_session_token(
+                    work.user_ids[i], pool[64 * k: 64 * (k + 1)]
+                )
+                pairs.append((tokens[i], work.user_ids[i]))
+            session_errs = await self.state.create_sessions(pairs)
+            for i, err in zip(verified, session_errs, strict=True):
+                if err is not None:
+                    success[i] = False
+                    work.messages[i] = f"Failed to create session: {err}"
+                    tokens.pop(i, None)
+        resp = Resp(
+            ids=work.ids,
+            success=success,
+            messages=work.messages,
+            retry_after_ms=retry_ms,
+        )
+        if tokens:
+            resp.session_tokens.extend(
+                tokens.get(i, "") for i in range(n)
+            )
+        return resp
+
+
+@dataclass(eq=False)  # identity hash: chunks live in the handler's
+class _StreamChunk:     # unsettled set until their verdicts are yielded
+    """One VerifyProofStream chunk moving through the pipeline."""
+
+    ids: list[int]
+    size: int
+    mint: bool
+    chunk_error: str | None = None
+    messages: list[str] = field(default_factory=list)
+    user_ids: list[str] = field(default_factory=list)
+    challenge_ids: list = field(default_factory=list)
+    proof_wires: list = field(default_factory=list)
+    staged: list[int] = field(default_factory=list)
+    shed: dict[int, int] = field(default_factory=dict)        # i -> retry ms
+    users: dict[int, UserData] = field(default_factory=dict)
+    results: list = field(default_factory=list)  # i -> verdict | _UNSET
+    task: asyncio.Task | None = None
+
 
 def _proof_args_error(challenge_id: bytes, proof: bytes, index: int | None = None) -> str | None:
     sfx = "" if index is None else f" for proof {index}"
@@ -553,7 +979,7 @@ def _proof_args_error(challenge_id: bytes, proof: bytes, index: int | None = Non
 
 
 def make_generic_handler(service: AuthServiceImpl) -> grpc.GenericRpcHandler:
-    """Register the five RPCs without generated *_pb2_grpc stubs."""
+    """Register the six RPCs without generated *_pb2_grpc stubs."""
     pb2 = service.pb2
     types = method_types(pb2)
     impl = {
@@ -571,6 +997,12 @@ def make_generic_handler(service: AuthServiceImpl) -> grpc.GenericRpcHandler:
         )
         for name in impl
     }
+    stream_types = stream_method_types(pb2)
+    handlers["VerifyProofStream"] = grpc.stream_stream_rpc_method_handler(
+        service.verify_proof_stream,
+        request_deserializer=stream_types["VerifyProofStream"][0].FromString,
+        response_serializer=stream_types["VerifyProofStream"][1].SerializeToString,
+    )
     return grpc.method_handlers_generic_handler(SERVICE_NAME, handlers)
 
 
@@ -584,6 +1016,9 @@ async def serve(
     tls: tuple[bytes, bytes] | None = None,
     admission=None,
     replica=None,
+    audit_log=None,
+    stream_window: int = 8192,
+    stream_entry_deadline_ms: float = 0.0,
 ):
     """Build and start an aio server; returns (server, bound_port).
 
@@ -599,12 +1034,19 @@ async def serve(
     :class:`~cpzk_tpu.replication.StandbyReplica`: its ReplicationService
     handler is registered alongside the auth service, readiness reports
     NOT_SERVING until promotion, and every auth RPC aborts UNAVAILABLE
-    while the node is still a standby.
+    while the node is still a standby.  ``audit_log`` is an optional
+    :class:`~cpzk_tpu.audit.ProofLogWriter` the verify paths append
+    (statement, challenge, proof, verdict) records to — the bulk audit
+    pipeline's input; the daemon closes it after the batcher drains.
+    ``stream_window`` / ``stream_entry_deadline_ms`` are the
+    VerifyProofStream flow-control knobs (``[tpu]`` config).
     """
     server = grpc.aio.server()
     service = AuthServiceImpl(
         state, rate_limiter, backend=backend, batcher=batcher,
-        admission=admission, replica=replica,
+        admission=admission, replica=replica, audit_log=audit_log,
+        stream_window=stream_window,
+        stream_entry_deadline_ms=stream_entry_deadline_ms,
     )
     server.add_generic_rpc_handlers((make_generic_handler(service),))
     if replica is not None:
@@ -617,6 +1059,7 @@ async def serve(
     server.batcher = batcher
     server.admission = admission
     server.replica = replica
+    server.audit_log = audit_log  # daemon closes it after the batcher drains
     if batcher is not None:
         batcher.start()
     addr = f"{host}:{port}"

@@ -39,6 +39,7 @@ def _load(name: str):
         ("fuzz_wal_replay", 300),
         ("fuzz_admission", 400),
         ("fuzz_lint", 150),
+        ("fuzz_audit_log", 400),
     ],
 )
 def test_fuzz_target_smoke(target, runs):

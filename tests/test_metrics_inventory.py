@@ -34,6 +34,7 @@ _RPC_PREFIXES = (
     "auth.challenge",
     "auth.verify",
     "auth.verify_batch",
+    "auth.verify_stream",
 )
 DYNAMIC_NAMES: dict[str, str] = {}
 for _prefix in _RPC_PREFIXES:

@@ -1,0 +1,480 @@
+"""VerifyProofStream tests: verdict correctness and ordering over real
+gRPC, session minting, per-proof keyed admission with mid-stream
+pushback (the hot-streamer chaos case), per-entry deadline shedding,
+backend-raise confinement, disconnect-leak-freedom (reusing the
+DispatchLane leak contract), chunk validation, and the client APIs."""
+
+import asyncio
+
+import grpc
+import pytest
+
+from cpzk_tpu import Parameters, Prover, SecureRng, Transcript, Witness
+from cpzk_tpu.admission import AdmissionController
+from cpzk_tpu.client import AuthClient
+from cpzk_tpu.core.ristretto import Ristretto255
+from cpzk_tpu.protocol.batch import CpuBackend, VerifierBackend
+from cpzk_tpu.server import RateLimiter, ServerState
+from cpzk_tpu.server.batching import DynamicBatcher
+from cpzk_tpu.server.config import AdmissionSettings
+from cpzk_tpu.server.service import MAX_STREAM_CHUNK, serve
+
+EB = Ristretto255.element_to_bytes
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+class ExplodingBackend(VerifierBackend):
+    """Raises for the first ``explode_times`` batches, then verifies."""
+
+    prefers_combined = False
+
+    def __init__(self, explode_times=0):
+        self.calls = 0
+        self.explode_times = explode_times
+        self._inner = CpuBackend()
+
+    def verify_combined(self, rows, beta):  # pragma: no cover - unused
+        raise AssertionError("prefers_combined is False")
+
+    def verify_each(self, rows):
+        self.calls += 1
+        if self.calls <= self.explode_times:
+            raise RuntimeError("injected device loss")
+        return self._inner.verify_each(rows)
+
+
+class Harness:
+    """One loopback server + registered provers + login-entry factory."""
+
+    def __init__(self, users=8, **serve_kwargs):
+        self.users = users
+        self.serve_kwargs = serve_kwargs
+        self.rng = SecureRng()
+        self.params = Parameters.new()
+        self.provers = [
+            Prover(self.params, Witness(Ristretto255.random_scalar(self.rng)))
+            for _ in range(users)
+        ]
+        self.state = ServerState()
+
+    async def __aenter__(self):
+        self.server, self.port = await serve(
+            self.state, RateLimiter(10**9, 10**9), port=0,
+            **self.serve_kwargs,
+        )
+        self.client = AuthClient(f"127.0.0.1:{self.port}")
+        resp = await self.client.register_batch(
+            [f"u{i}" for i in range(self.users)],
+            [EB(p.statement.y1) for p in self.provers],
+            [EB(p.statement.y2) for p in self.provers],
+        )
+        assert all(r.success for r in resp.results)
+        return self
+
+    async def __aexit__(self, *exc):
+        await self.client.close()
+        batcher = self.serve_kwargs.get("batcher")
+        if batcher is not None:
+            await batcher.stop()
+        await self.server.stop(None)
+
+    async def entries(self, n, corrupt=(), wrong_user=(), direct=False):
+        """Login-ready (user, challenge_id, proof) tuples.  ``direct``
+        mints challenges straight into server state — for tests whose
+        admission config would shed the setup RPCs themselves."""
+        out = []
+        for k in range(n):
+            u = k % self.users
+            if direct:
+                cid = self.state.tag_challenge_id(
+                    f"u{u}", self.rng.fill_bytes(32))
+                await self.state.create_challenge(f"u{u}", cid)
+            else:
+                ch = await self.client.create_challenge(f"u{u}")
+                cid = bytes(ch.challenge_id)
+            t = Transcript()
+            t.append_context(cid)
+            wire = self.provers[u].prove_with_transcript(self.rng, t).to_bytes()
+            if k in corrupt:
+                wire = wire[:-1] + bytes([wire[-1] ^ 1])
+            uid = f"u{(u + 1) % self.users}" if k in wrong_user else f"u{u}"
+            out.append((uid, cid, wire))
+        return out
+
+
+# --- verdict correctness -----------------------------------------------------
+
+
+def test_stream_verdicts_ordered_and_correct():
+    async def main():
+        backend = CpuBackend()
+        batcher = DynamicBatcher(backend, max_batch=16, window_ms=1.0)
+        async with Harness(backend=backend, batcher=batcher) as h:
+            entries = await h.entries(24, corrupt={3}, wrong_user={5})
+            verdicts = [
+                v async for v in h.client.verify_proof_stream(
+                    entries, chunk=7)
+            ]
+            assert [v.id for v in verdicts] == list(range(24))
+            for v in verdicts:
+                if v.id == 3:
+                    assert not v.ok and v.message == "Authentication failed"
+                elif v.id == 5:
+                    # wrong user for the challenge: consumed AND refused
+                    assert not v.ok and v.message == "Authentication failed"
+                else:
+                    assert v.ok, (v.id, v.message)
+                    assert v.session_token is None  # mint off by default
+    run(main())
+
+
+def test_stream_mints_sessions_on_request():
+    async def main():
+        backend = CpuBackend()
+        batcher = DynamicBatcher(backend, max_batch=16, window_ms=1.0)
+        async with Harness(backend=backend, batcher=batcher) as h:
+            entries = await h.entries(6)
+            verdicts = [
+                v async for v in h.client.verify_proof_stream(
+                    entries, mint_sessions=True)
+            ]
+            assert all(v.ok and v.session_token for v in verdicts)
+            assert await h.state.session_count() == 6
+            # the minted token is a real session
+            user = await h.state.validate_session(
+                verdicts[0].session_token)
+            assert user == "u0"
+    run(main())
+
+
+def test_stream_inline_cpu_path_without_batcher():
+    """No batcher wired (reference-parity inline config): the stream
+    still answers through the shared dispatch seam."""
+    async def main():
+        async with Harness(backend=None, batcher=None) as h:
+            entries = await h.entries(5, corrupt={2})
+            oks = [
+                v.ok async for v in h.client.verify_proof_stream(entries)
+            ]
+            assert oks == [True, True, False, True, True]
+    run(main())
+
+
+def test_stream_consumes_challenges_single_use():
+    async def main():
+        backend = CpuBackend()
+        batcher = DynamicBatcher(backend, max_batch=16, window_ms=1.0)
+        async with Harness(backend=backend, batcher=batcher) as h:
+            entries = await h.entries(3)
+            first = [
+                v.ok async for v in h.client.verify_proof_stream(entries)
+            ]
+            assert first == [True] * 3
+            # resend: every challenge is already consumed
+            second = [
+                v async for v in h.client.verify_proof_stream(entries)
+            ]
+            assert all(not v.ok for v in second)
+            assert all(
+                v.message == "Authentication failed" for v in second)
+    run(main())
+
+
+# --- chunk validation --------------------------------------------------------
+
+
+def test_stream_malformed_chunks_answered_not_fatal():
+    async def main():
+        backend = CpuBackend()
+        batcher = DynamicBatcher(backend, max_batch=16, window_ms=1.0)
+        async with Harness(backend=backend, batcher=batcher) as h:
+            pb2 = h.client.pb2
+            call = h.client._stream_stub()
+            # mismatched arrays
+            await call.write(pb2.StreamVerifyRequest(
+                ids=[0, 1], user_ids=["u0"], challenge_ids=[b"x"],
+                proofs=[b"y"]))
+            # oversized chunk
+            n = MAX_STREAM_CHUNK + 1
+            await call.write(pb2.StreamVerifyRequest(
+                ids=list(range(n)), user_ids=["u0"] * n,
+                challenge_ids=[b"x"] * n, proofs=[b"y"] * n))
+            # then a real login: the stream is still alive
+            (uid, cid, wire), = await h.entries(1)
+            await call.write(pb2.StreamVerifyRequest(
+                ids=[7], user_ids=[uid], challenge_ids=[cid],
+                proofs=[wire]))
+            await call.done_writing()
+            resps = [r async for r in call]
+            assert len(resps) == 3
+            assert list(resps[0].success) == [False, False]
+            assert "Mismatched array lengths" in resps[0].messages[0]
+            assert not any(resps[1].success)
+            assert "maximum" in resps[1].messages[0]
+            assert list(resps[2].ids) == [7]
+            assert list(resps[2].success) == [True]
+    run(main())
+
+
+# --- admission: per-proof charging + mid-stream pushback --------------------
+
+
+def test_hot_streamer_shed_per_proof_with_pushback_stream_survives():
+    """Chaos case: a hot streamer blows through its keyed bucket mid-
+    stream.  Its over-budget entries get NOT-verdicts with a retry delay
+    (and the stream's trailing metadata carries cpzk-retry-after-ms);
+    the stream is NOT killed, and in-budget entries still verify."""
+    async def main():
+        backend = CpuBackend()
+        batcher = DynamicBatcher(backend, max_batch=64, window_ms=1.0)
+        settings = AdmissionSettings(
+            per_client_rpm=60, per_client_burst=10, max_clients=16,
+        )
+        admission = AdmissionController(settings, batcher=batcher)
+        async with Harness(
+            backend=backend, batcher=batcher, admission=admission,
+        ) as h:
+            entries = await h.entries(16, direct=True)
+            pb2 = h.client.pb2
+            call = h.client._stream_stub(
+                metadata=(("cpzk-client-id", "hot-streamer"),)
+            )
+            ids = list(range(16))
+            await call.write(pb2.StreamVerifyRequest(
+                ids=ids,
+                user_ids=[e[0] for e in entries],
+                challenge_ids=[e[1] for e in entries],
+                proofs=[e[2] for e in entries],
+            ))
+            await call.done_writing()
+            resps = [r async for r in call]
+            flat_ok = [s for r in resps for s in r.success]
+            flat_msg = [m for r in resps for m in r.messages]
+            # burst of 10 admitted and verified; the rest shed per proof
+            assert sum(flat_ok) == 10
+            shed = [m for ok, m in zip(flat_ok, flat_msg) if not ok]
+            assert all("rate limit" in m.lower() for m in shed)
+            assert any(r.retry_after_ms > 0 for r in resps)
+            code = await call.code()
+            assert code == grpc.StatusCode.OK  # stream survived
+            trailing = {
+                str(k): v for k, v in (await call.trailing_metadata() or ())
+            }
+            assert float(trailing["cpzk-retry-after-ms"]) > 0
+
+            # a well-behaved client (own bucket) is unaffected
+            entries2 = await h.entries(4, direct=True)
+            async with AuthClient(
+                f"127.0.0.1:{h.port}", client_id="polite"
+            ) as polite:
+                oks = [
+                    v.ok async for v in polite.verify_proof_stream(entries2)
+                ]
+            assert oks == [True] * 4
+    run(main())
+
+
+# --- per-entry deadline shedding ---------------------------------------------
+
+
+def test_stream_entry_deadline_sheds_with_per_entry_not_verdicts():
+    async def main():
+        backend = CpuBackend()
+        batcher = DynamicBatcher(backend, max_batch=16, window_ms=20.0)
+        async with Harness(
+            backend=backend, batcher=batcher,
+            stream_entry_deadline_ms=0.01,  # expires before the window
+        ) as h:
+            entries = await h.entries(5)
+            verdicts = [
+                v async for v in h.client.verify_proof_stream(entries)
+            ]
+            assert len(verdicts) == 5
+            assert all(not v.ok for v in verdicts)
+            assert all(
+                v.message == "Deadline expired before verification"
+                for v in verdicts
+            )
+            # the challenges were still consumed (consume precedes
+            # verification, deadline or not) — single-use holds
+            assert await h.state.challenge_count() == 0
+    run(main())
+
+
+# --- failure isolation -------------------------------------------------------
+
+
+def test_backend_raise_confined_to_its_chunk_stream_survives():
+    async def main():
+        backend = ExplodingBackend(explode_times=1)
+        batcher = DynamicBatcher(backend, max_batch=4, window_ms=1.0)
+        async with Harness(backend=backend, batcher=batcher) as h:
+            entries = await h.entries(8)
+            # two chunks of 4 -> two device batches (max_batch=4); the
+            # first explodes, the second must still verify
+            pb2 = h.client.pb2
+            call = h.client._stream_stub()
+            for lo in (0, 4):
+                part = entries[lo:lo + 4]
+                await call.write(pb2.StreamVerifyRequest(
+                    ids=list(range(lo, lo + 4)),
+                    user_ids=[e[0] for e in part],
+                    challenge_ids=[e[1] for e in part],
+                    proofs=[e[2] for e in part],
+                ))
+                # settle chunk 1 before sending chunk 2 so the batcher
+                # cannot coalesce them into one batch
+                if lo == 0:
+                    first = await call.read()
+                    assert not any(first.success)
+                    assert all(
+                        m == "Verification unavailable"
+                        for m in first.messages
+                    )
+            await call.done_writing()
+            second = await call.read()
+            assert second is not grpc.aio.EOF
+            assert all(second.success), second.messages
+            assert await call.read() is grpc.aio.EOF
+            assert await call.code() == grpc.StatusCode.OK
+    run(main())
+
+
+# --- disconnect leak-freedom -------------------------------------------------
+
+
+def test_client_disconnect_mid_stream_leaks_no_futures():
+    """Abandon a stream with chunks in flight: the server tears the
+    handler down, the batcher's in-flight accounting returns to zero
+    (DispatchLane leak contract), and the NEXT stream works."""
+    async def main():
+        backend = CpuBackend()
+        batcher = DynamicBatcher(backend, max_batch=8, window_ms=1.0)
+        async with Harness(backend=backend, batcher=batcher) as h:
+            entries = await h.entries(16)
+            got = 0
+            async for v in h.client.verify_proof_stream(entries, chunk=4):
+                got += 1
+                break  # abandon mid-stream (generator finally cancels)
+            assert got == 1
+            # drain: every queued/claimed entry must resolve or be shed
+            deadline = asyncio.get_running_loop().time() + 5.0
+            while asyncio.get_running_loop().time() < deadline:
+                depth, _ = batcher.load_snapshot()
+                if depth == 0:
+                    break
+                await asyncio.sleep(0.02)
+            depth, _ = batcher.load_snapshot()
+            assert depth == 0, "abandoned stream left entries in flight"
+            # the server still serves: a fresh stream verifies cleanly
+            entries2 = await h.entries(3)
+            oks = [
+                v.ok async for v in h.client.verify_proof_stream(entries2)
+            ]
+            assert oks == [True] * 3
+    run(main())
+
+
+# --- client API equivalence --------------------------------------------------
+
+
+def test_chunk_iterator_and_verdict_iterator_agree():
+    async def main():
+        backend = CpuBackend()
+        batcher = DynamicBatcher(backend, max_batch=16, window_ms=1.0)
+        async with Harness(backend=backend, batcher=batcher) as h:
+            entries = await h.entries(10, corrupt={4})
+            flat = [
+                (v.id, v.ok) async for v in h.client.verify_proof_stream(
+                    entries, chunk=3)
+            ]
+            entries2 = await h.entries(10, corrupt={4})
+            chunked = []
+            async for ids, succ, msgs, toks, push in (
+                h.client.verify_proof_stream_chunks(entries2, chunk=3)
+            ):
+                chunked.extend(zip(ids, succ))
+                assert len(ids) == len(succ) == len(msgs)
+            assert flat == [(i, i != 4) for i in range(10)]
+            assert chunked == flat
+    run(main())
+
+
+def test_batcher_settled_results_mix_verdicts_and_exceptions():
+    """The settled contract under the stream: a deadline-expired entry
+    comes back AS its exception while batch siblings carry verdicts —
+    via both submit_many(settled=True) and the group-future enqueue."""
+    import time as _time
+
+    from cpzk_tpu import Parameters, SecureRng
+    from cpzk_tpu.protocol.batch import BatchEntry
+    from cpzk_tpu.server.batching import DeadlineExceeded
+
+    rng = SecureRng()
+    params = Parameters.new()
+
+    def login_entries():
+        prover = Prover(params, Witness(Ristretto255.random_scalar(rng)))
+        out = []
+        for k in range(3):
+            ctx = b"settled-%d" % k
+            t = Transcript()
+            t.append_context(ctx)
+            proof = prover.prove_with_transcript(rng, t)
+            out.append(BatchEntry(params, prover.statement, proof, ctx))
+        out[1].deadline = _time.monotonic() - 1.0  # already expired
+        return out
+
+    async def main():
+        batcher = DynamicBatcher(CpuBackend(), max_batch=8, window_ms=1.0)
+        batcher.start()
+        try:
+            for submit in (
+                lambda e: batcher.submit_many(e, settled=True),
+                batcher.submit_group,
+            ):
+                results = await submit(login_entries())
+                assert results[0] is None and results[2] is None
+                assert isinstance(results[1], DeadlineExceeded)
+        finally:
+            await batcher.stop()
+
+    run(main())
+
+
+def test_stream_refused_on_unpromoted_standby():
+    class FakeReplica:
+        role = "standby"
+
+    class FakeContext:
+        def invocation_metadata(self):
+            return ()
+
+        def peer(self):
+            return "ipv4:127.0.0.1:1"
+
+        def time_remaining(self):
+            return None
+
+        async def abort(self, code, msg, **kw):
+            raise RuntimeError(f"aborted:{code.name}:{msg}")
+
+    from cpzk_tpu.server.service import AuthServiceImpl
+
+    async def main():
+        service = AuthServiceImpl(
+            ServerState(), RateLimiter(10**9, 10**9),
+            replica=FakeReplica(),
+        )
+
+        async def no_requests():
+            return
+            yield  # pragma: no cover
+
+        agen = service.verify_proof_stream(no_requests(), FakeContext())
+        with pytest.raises(RuntimeError, match="aborted:UNAVAILABLE"):
+            await agen.__anext__()
+    run(main())
