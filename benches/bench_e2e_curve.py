@@ -114,6 +114,21 @@ async def grpc_curve_point(
         state, RateLimiter(10**9, 10**9), host="127.0.0.1", port=0,
         backend=backend, batcher=batcher,
     )
+    # CPZK_BENCH_OPSPLANE=1: run the full HTTP introspection server +
+    # SLO engine alongside the timed passes — the perf gate's proof that
+    # the ops plane costs nothing measurable on the serving path
+    ops_plane = None
+    if os.environ.get("CPZK_BENCH_OPSPLANE"):
+        from cpzk_tpu.observability.opsplane import OpsPlane, OpsSources
+        from cpzk_tpu.observability.slo import SloEngine
+        from cpzk_tpu.server.config import SloSettings
+
+        ops_plane = OpsPlane(OpsSources(
+            state=state, batcher=batcher, backend=backend,
+            health=server.health, service=server.auth_service,
+            slo=SloEngine(SloSettings()),
+        ), port=0)
+        await ops_plane.start()
     eb = Ristretto255.element_to_bytes
     timed = 0.0
     done = 0
@@ -216,6 +231,8 @@ async def grpc_curve_point(
                 assert n_ok == wave, f"stream verify failed: {n_ok}/{wave}"
                 done += wave
     finally:
+        if ops_plane is not None:
+            await ops_plane.stop()
         if batcher is not None:
             await batcher.stop()
         await server.stop(None)

@@ -23,8 +23,13 @@ import ast
 
 from ..engine import Finding, Module, Rule, dotted_parts, register
 
-#: Planes whose async defs feed the serving event loop.
-ASYNC_PLANES = frozenset({"server", "client", "durability", "admission"})
+#: Planes whose async defs feed the serving event loop.  ``observability``
+#: joined when the ops plane's HTTP handler loop moved onto the serving
+#: event loop (ISSUE 10): a blocking call in a /statusz render would
+#: stall every RPC exactly like one in a handler would.
+ASYNC_PLANES = frozenset(
+    {"server", "client", "durability", "admission", "observability"}
+)
 
 #: Dotted-call prefixes that block the calling thread.
 BLOCKING_PREFIXES: tuple[tuple[str, ...], ...] = (

@@ -85,6 +85,25 @@ def cmd_run(args) -> int:
 
     t0 = time.monotonic()
 
+    # optional ops plane: a long bulk replay is a fleet workload too —
+    # expose /metrics (audit.* counters incl. the proof-log families),
+    # /healthz, and the ring dumps on a daemon-thread HTTP server while
+    # the synchronous pipeline runs
+    ops_plane = None
+    if args.opsplane_port is not None:
+        from ..observability.opsplane import OpsPlane, OpsSources
+
+        ops_plane = OpsPlane(
+            OpsSources(role="audit"),
+            host=args.opsplane_host, port=args.opsplane_port,
+        )
+        bound = ops_plane.start_in_thread()
+        print(
+            f"# ops plane on http://{args.opsplane_host}:{bound} "
+            "(/metrics /healthz /statusz)",
+            file=sys.stderr, flush=True,
+        )
+
     def progress(state) -> None:
         if not args.quiet:
             dt = time.monotonic() - t0
@@ -95,17 +114,21 @@ def cmd_run(args) -> int:
                 file=sys.stderr, flush=True,
             )
 
-    report = run_audit(
-        args.log, args.report,
-        cursor_path=args.cursor,
-        key_path=args.key,
-        quantum=args.quantum,
-        backend=args.backend,
-        mesh_devices=args.mesh_devices,
-        resume=not args.fresh,
-        max_batches=args.max_batches,
-        progress=progress,
-    )
+    try:
+        report = run_audit(
+            args.log, args.report,
+            cursor_path=args.cursor,
+            key_path=args.key,
+            quantum=args.quantum,
+            backend=args.backend,
+            mesh_devices=args.mesh_devices,
+            resume=not args.fresh,
+            max_batches=args.max_batches,
+            progress=progress,
+        )
+    finally:
+        if ops_plane is not None:
+            ops_plane.stop_thread()
     if report is None:
         print(json.dumps({"status": "checkpointed", "report": None}))
         return 0
@@ -165,6 +188,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="stop (checkpointed) after this many quanta — "
                         "test hook modelling a crash between checkpoints")
     r.add_argument("--quiet", action="store_true")
+    r.add_argument("--opsplane-port", type=int, default=None,
+                   help="serve the HTTP ops plane (/metrics /healthz "
+                        "/statusz) on this port while the replay runs "
+                        "(0 = OS-assigned)")
+    r.add_argument("--opsplane-host", default="127.0.0.1")
     r.set_defaults(fn=cmd_run)
 
     v = sub.add_parser("verify-report", help="offline signed-report check")

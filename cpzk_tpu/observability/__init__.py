@@ -30,6 +30,8 @@ from .flightrec import (
 )
 from .instrument import rpc_deadline, traced_rpc, traced_stream_rpc
 from .logs import JsonLogFormatter, enable_json_logs
+from .opsplane import OpsPlane, OpsSources
+from .slo import SloEngine
 from .tracing import (
     BatchStages,
     SpanRecord,
@@ -45,7 +47,10 @@ __all__ = [
     "FlightRecord",
     "FlightRecorder",
     "JsonLogFormatter",
+    "OpsPlane",
+    "OpsSources",
     "RequestContext",
+    "SloEngine",
     "SpanRecord",
     "TraceRecord",
     "Tracer",

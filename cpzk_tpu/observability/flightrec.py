@@ -338,13 +338,20 @@ class FlightRecorder:
         with self._lock:
             return self._pps_ewma
 
-    def to_json(self, n: int | None = None) -> str:
-        payload = {
+    def payload(self, n: int | None = None) -> dict:
+        """THE ``cpzk-flightrec/1`` payload — the single serializer behind
+        the REPL ``/flightrec`` rendering, the SIGUSR2 dump, and the ops
+        plane's HTTP ``/flightrec`` (one schema, one code path: the three
+        surfaces cannot drift)."""
+        return {
             "schema": SCHEMA,
             "dumped_at": time.time(),
+            "proofs_per_s_ewma": self.proofs_per_s(),
             "records": [r.to_dict() for r in self.snapshot(n)],
         }
-        return json.dumps(payload, indent=2, sort_keys=True)
+
+    def to_json(self, n: int | None = None) -> str:
+        return json.dumps(self.payload(n), indent=2, sort_keys=True)
 
     def dump(self, path: str, n: int | None = None) -> str:
         """Write the ring as JSON to ``path`` (the SIGUSR2 hook target).
@@ -367,30 +374,35 @@ def get_flight_recorder() -> FlightRecorder:
 # -- operator rendering -------------------------------------------------------
 
 
-def format_record(rec: FlightRecord) -> str:
-    """One ``/flightrec`` line: shape, occupancy, gap, stage breakdown."""
+def format_record(rec: dict) -> str:
+    """One ``/flightrec`` line: shape, occupancy, gap, stage breakdown.
+    Consumes a serialized record dict (``FlightRecord.to_dict``) — the
+    REPL renders the same payload the HTTP endpoint serves."""
+    stages_s = rec.get("stages_s", {})
     stages = " ".join(
-        f"{name}={rec.stages_s.get(name, 0.0) * 1000:.2f}ms"
+        f"{name}={stages_s.get(name, 0.0) * 1000:.2f}ms"
         for name in RECORD_STAGES
     )
     return (
-        f"#{rec.seq} n={rec.batch} lanes={rec.lanes} "
-        f"occ={rec.occupancy:.2f} gap={rec.dispatch_gap_s * 1000:.2f}ms "
-        f"wait={rec.queue_wait_s * 1000:.2f}ms {stages} "
-        f"wall={rec.wall_s * 1000:.2f}ms "
-        f"jit={rec.jit_hits}h/{rec.jit_misses}m {rec.backend}"
+        f"#{rec['seq']} n={rec['batch']} lanes={rec['lanes']} "
+        f"occ={rec['occupancy']:.2f} gap={rec['dispatch_gap_s'] * 1000:.2f}ms "
+        f"wait={rec['queue_wait_s'] * 1000:.2f}ms {stages} "
+        f"wall={rec['wall_s'] * 1000:.2f}ms "
+        f"jit={rec['jit_hits']}h/{rec['jit_misses']}m {rec['backend']}"
     )
 
 
-def format_flightrec(records: list[FlightRecord], limit: int = 20) -> str:
+def format_flightrec(payload: dict, limit: int = 20) -> str:
     """The admin REPL ``/flightrec`` body: last ``limit`` batches, newest
-    first, one line each, plus the rolling throughput header."""
-    recent = records[-limit:][::-1]
+    first, one line each, plus the rolling throughput header.  Takes the
+    :meth:`FlightRecorder.payload` dict — the REPL is a text rendering
+    of EXACTLY the JSON the HTTP endpoint and SIGUSR2 dump emit."""
+    recent = payload.get("records", [])[-limit:][::-1]
     if not recent:
         return "no recorded batches yet"
     lines = [
         f"last {len(recent)} device batches (newest first), "
-        f"~{get_flight_recorder().proofs_per_s():.0f} proofs/s EWMA:"
+        f"~{payload.get('proofs_per_s_ewma', 0.0):.0f} proofs/s EWMA:"
     ]
     lines += ["  " + format_record(r) for r in recent]
     return "\n".join(lines)

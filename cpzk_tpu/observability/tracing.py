@@ -61,6 +61,10 @@ _STAGE_HISTOGRAM = {
 }
 
 
+#: JSON payload schema tag of the ``/tracez`` dump (REPL + HTTP).
+TRACEZ_SCHEMA = "cpzk-tracez/1"
+
+
 @dataclass
 class SpanRecord:
     """One completed stage within a trace."""
@@ -70,6 +74,14 @@ class SpanRecord:
     start: float
     duration_s: float
     attrs: dict = field(default_factory=dict)
+
+    def to_dict(self) -> dict:
+        return {
+            "name": self.name,
+            "start": self.start,
+            "duration_s": self.duration_s,
+            "attrs": {k: v for k, v in sorted(self.attrs.items())},
+        }
 
 
 @dataclass
@@ -91,6 +103,17 @@ class TraceRecord:
     def stage_seconds(self, name: str) -> float:
         """Total recorded duration of all spans named ``name``."""
         return sum(s.duration_s for s in self.spans if s.name == name)
+
+    def to_dict(self) -> dict:
+        return {
+            "trace_id": self.trace_id,
+            "name": self.name,
+            "attempt": self.attempt,
+            "start_wall": self.start_wall,
+            "duration_s": self.duration_s,
+            "status": self.status,
+            "spans": [s.to_dict() for s in self.spans],
+        }
 
 
 class Tracer:
@@ -229,6 +252,17 @@ class Tracer:
     def find(self, trace_id: str) -> list[TraceRecord]:
         """All completed attempts of one trace id, oldest first."""
         return [t for t in self.completed() if t.trace_id == trace_id]
+
+    def payload(self, n: int | None = None) -> dict:
+        """THE ``cpzk-tracez/1`` payload — the single serializer behind
+        the REPL ``/tracez`` rendering and the ops plane's HTTP
+        ``/tracez`` (one schema, one code path: the surfaces cannot
+        drift)."""
+        return {
+            "schema": TRACEZ_SCHEMA,
+            "dumped_at": time.time(),
+            "traces": [t.to_dict() for t in self.completed(n)],
+        }
 
 
 _TRACER = Tracer()
@@ -441,22 +475,26 @@ class BatchStages:
 # -- operator rendering -------------------------------------------------------
 
 
-def format_trace(rec: TraceRecord) -> str:
-    """One ``/tracez`` line: id, name, outcome, total, stage breakdown."""
+def format_trace(rec: dict) -> str:
+    """One ``/tracez`` line: id, name, outcome, total, stage breakdown.
+    Consumes a serialized trace dict (``TraceRecord.to_dict``) — the
+    REPL renders the same payload the HTTP endpoint serves."""
     stages = " ".join(
-        f"{s.name}={s.duration_s * 1000:.2f}ms" for s in rec.spans
+        f"{s['name']}={s['duration_s'] * 1000:.2f}ms" for s in rec["spans"]
     )
     head = (
-        f"{rec.trace_id[:16]} {rec.name} {rec.status} "
-        f"total={rec.duration_s * 1000:.2f}ms attempt={rec.attempt}"
+        f"{rec['trace_id'][:16]} {rec['name']} {rec['status']} "
+        f"total={rec['duration_s'] * 1000:.2f}ms attempt={rec['attempt']}"
     )
     return f"{head} {stages}".rstrip()
 
 
-def format_tracez(traces: list[TraceRecord], limit: int = 20) -> str:
+def format_tracez(payload: dict, limit: int = 20) -> str:
     """The admin REPL ``/tracez`` body: last ``limit`` traces, newest
-    first, one line each."""
-    recent = traces[-limit:][::-1]
+    first, one line each.  Takes the :meth:`Tracer.payload` dict — the
+    REPL is a text rendering of EXACTLY the JSON the HTTP endpoint
+    serves."""
+    recent = payload.get("traces", [])[-limit:][::-1]
     if not recent:
         return "no completed traces yet"
     lines = [f"last {len(recent)} completed traces (newest first):"]

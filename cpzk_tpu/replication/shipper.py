@@ -30,6 +30,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import time
 
 import grpc
 
@@ -73,6 +74,9 @@ class SegmentShipper:
         self.acked_offset = 0
         self.acked_seq = 0
         self.segments_shipped = 0
+        #: ``time.monotonic()`` of the last ACCEPTED segment ship (None
+        #: until the first) — the ``last_ship_age_s`` row of /statusz
+        self.last_ship_at: float | None = None
         self.fenced = False
         self.gap_stalled = False
         self.crashed: BaseException | None = None
@@ -250,13 +254,23 @@ class SegmentShipper:
             first_seq=seg.first_seq, last_seq=seg.last_seq,
             frames=frames, crc32=seg.crc, sealed=seg.sealed,
             primary_seq=self._wal_seq(),
+            # wall-clock send stamp: the applier reports its apply-time
+            # lag against this into state.repl.apply_lag_seconds
+            sent_unix_ms=int(time.time() * 1000.0),
         )
+        t0 = time.monotonic()
         resp = await stub.ship_segment(
             req, timeout=self.settings.sync_timeout_ms / 1000.0
+        )
+        # ship RTT: request out -> response in, the wire half of the
+        # replication lag an operator sees on /statusz and /metrics
+        metrics.histogram("state.repl.ship_rtt").observe(
+            time.monotonic() - t0
         )
         if resp.accepted:
             self._index = seg.index + 1
             self.segments_shipped += 1
+            self.last_ship_at = time.monotonic()
             self.acked_seq = max(self.acked_seq, int(resp.applied_seq))
             self.acked_offset += len(frames)
             self.gap_stalled = False
@@ -359,7 +373,8 @@ class SegmentShipper:
     # -- introspection -----------------------------------------------------
 
     def status(self) -> dict:
-        """The admin REPL ``/replication`` payload (primary side)."""
+        """The admin REPL ``/replication`` payload (primary side) — also
+        the ``replication`` block of the ops plane's ``/statusz``."""
         wal_seq = self._wal_seq()
         return {
             "role": "primary",
@@ -370,6 +385,10 @@ class SegmentShipper:
             "acked_seq": self.acked_seq,
             "lag_records": max(0, wal_seq - self.acked_seq),
             "segments_shipped": self.segments_shipped,
+            "last_ship_age_s": (
+                None if self.last_ship_at is None
+                else round(time.monotonic() - self.last_ship_at, 3)
+            ),
             "fenced": self.fenced,
             "gap_stalled": self.gap_stalled,
         }

@@ -195,6 +195,7 @@ class StandbyReplica:
         # must not interleave between two concurrent ShipSegment handlers
         self._apply_lock = asyncio.Lock()
         self._last_contact: float | None = None  # lease armed at 1st contact
+        self._last_segment_at: float | None = None  # last ACCEPTED segment
         self._watch_task: asyncio.Task | None = None
         self._promotions = 0
         metrics.gauge("state.repl.role").set(0.0)
@@ -235,6 +236,10 @@ class StandbyReplica:
             "records_skipped": self.applier.records_skipped,
             "fenced": self.applier.fenced,
             "lease_remaining_s": lease,
+            "last_ship_age_s": (
+                None if self._last_segment_at is None
+                else round(time.monotonic() - self._last_segment_at, 3)
+            ),
             "promotions": self._promotions,
         }
 
@@ -319,6 +324,15 @@ class StandbyReplica:
                         )
                         self.applier.commit(new)
                         message = f"applied {len(new)} records"
+                    self._last_segment_at = time.monotonic()
+                    # apply lag against the shipper's send stamp: wall
+                    # clock from "primary wrote it" to "standby applied
+                    # it" (clock skew shows as a level shift, not noise)
+                    sent_ms = int(getattr(request, "sent_unix_ms", 0))
+                    if sent_ms > 0:
+                        metrics.histogram(
+                            "state.repl.apply_lag_seconds"
+                        ).observe(max(0.0, time.time() - sent_ms / 1000.0))
                     self.applier.note_primary_seq(int(request.primary_seq))
                     self._renew_lease()
             if not accepted:

@@ -261,7 +261,8 @@ async def handle_command(
             limit = int(parts[1]) if len(parts) > 1 else 20
         except ValueError:
             return f"usage: /tracez [N] — not a number: {parts[1]}", False
-        return format_tracez(get_tracer().completed(), limit=max(1, limit)), False
+        # same serializer as the ops plane's HTTP /tracez (one schema)
+        return format_tracez(get_tracer().payload(), limit=max(1, limit)), False
     if word in ("/flightrec", "/fr"):
         from ..observability import format_flightrec, get_flight_recorder
 
@@ -270,8 +271,9 @@ async def handle_command(
             limit = int(parts[1]) if len(parts) > 1 else 20
         except ValueError:
             return f"usage: /flightrec [N] — not a number: {parts[1]}", False
+        # same serializer as the HTTP /flightrec and the SIGUSR2 dump
         return format_flightrec(
-            get_flight_recorder().snapshot(), limit=max(1, limit)
+            get_flight_recorder().payload(), limit=max(1, limit)
         ), False
     if word in ("/profile", "/prof"):
         from ..observability import flightrec as flightrec_mod
@@ -497,11 +499,23 @@ async def amain(args) -> None:
     limiter = config.rate_limit.build_limiter()
     stop = asyncio.Event()
 
+    metrics_fallback_needed = False
     if config.metrics.enabled:
-        from . import metrics
-
         if metrics.start_exporter(config.metrics.host, config.metrics.port):
             log.info("metrics exporter on %s:%d", config.metrics.host, config.metrics.port)
+        else:
+            # satellite fix: this used to return False silently, leaving a
+            # configured metrics port with no listener and no log line —
+            # now the ops plane serves the facade's own text exposition on
+            # that same port, and says so
+            metrics_fallback_needed = True
+            log.warning(
+                "prometheus_client is not installed: the metrics exporter "
+                "cannot start; serving the metrics facade's own text "
+                "exposition at http://%s:%d/metrics via the ops plane "
+                "instead (identical family set)",
+                config.metrics.host, config.metrics.port,
+            )
 
     tls = None
     if config.tls.enabled:
@@ -580,6 +594,58 @@ async def amain(args) -> None:
         )
     )
 
+    # ops plane + SLO engine: the remote introspection surface, started
+    # BEFORE the gRPC listener so a recovering/standby box is observable
+    # before (and whether or not) it takes traffic
+    from ..observability.opsplane import OpsPlane, OpsSources
+    from ..observability.slo import SloEngine
+
+    slo_engine = SloEngine(config.slo)
+
+    async def slo_ticker() -> None:
+        interval = config.slo.tick_interval_ms / 1000.0
+        while not stop.is_set():
+            try:
+                await asyncio.wait_for(stop.wait(), timeout=interval)
+                return
+            except asyncio.TimeoutError:
+                pass
+            try:
+                slo_engine.tick()
+            except Exception:
+                log.exception("SLO tick failed; continuing")
+
+    slo_task = asyncio.create_task(slo_ticker())
+
+    ops_sources = OpsSources(
+        state=state,
+        batcher=batcher,
+        backend=backend,
+        admission=admission,
+        replication=shipper or replica,
+        audit_log=audit_log,
+        durability=durability,
+        slo=slo_engine,
+        config_fingerprint=config.fingerprint(),
+        role="standby" if replica is not None else "server",
+    )
+    ops_plane = None
+    if config.opsplane.enabled:
+        ops_plane = OpsPlane(
+            ops_sources, host=config.opsplane.host, port=config.opsplane.port
+        )
+        bound = await ops_plane.start()
+        log.info(
+            "ops plane on http://%s:%d (/metrics /statusz /tracez "
+            "/flightrec /healthz /slo)", config.opsplane.host, bound,
+        )
+    metrics_fallback_plane = None
+    if metrics_fallback_needed:
+        metrics_fallback_plane = OpsPlane(
+            ops_sources, host=config.metrics.host, port=config.metrics.port
+        )
+        await metrics_fallback_plane.start()
+
     server, port = await serve(
         state, limiter, host=config.host, port=config.port,
         backend=backend, batcher=batcher, tls=tls, admission=admission,
@@ -587,6 +653,9 @@ async def amain(args) -> None:
         stream_window=config.tpu.stream_window,
         stream_entry_deadline_ms=config.tpu.stream_entry_deadline_ms,
     )
+    # late attachments: serve() built these (health gate, stream registry)
+    ops_sources.health = server.health
+    ops_sources.service = server.auth_service
     if shipper is not None:
         shipper.start()
     if replica is not None:
@@ -657,6 +726,15 @@ async def amain(args) -> None:
     if replica is not None:
         await replica.stop()
     await server.stop(grace=5)
+    # the ops plane outlives the gRPC listener (it watched the drain);
+    # stop it after so the last /statusz of a shutdown is observable
+    if ops_plane is not None:
+        await ops_plane.stop()
+    if metrics_fallback_plane is not None:
+        await metrics_fallback_plane.stop()
+    slo_task.cancel()
+    with contextlib.suppress(asyncio.CancelledError):
+        await slo_task
     cleanup_task.cancel()
     with contextlib.suppress(asyncio.CancelledError):
         await cleanup_task

@@ -92,6 +92,56 @@ class TpuSettings:
 
 
 @dataclass
+class OpsplaneSettings:
+    """HTTP introspection server (ops plane): remote, read-only access to
+    ``/metrics``, ``/statusz``, ``/tracez``, ``/flightrec``, ``/healthz``,
+    and ``/slo`` — the surfaces that were REPL-only before.  Dependency-
+    free (stdlib asyncio); started by the daemon BEFORE the gRPC listener
+    so a booting box is observable while it recovers.  No auth layer:
+    bind to loopback (default) or an internal interface.  See
+    ``docs/operations.md`` §"Ops plane & SLOs"."""
+
+    enabled: bool = False
+    host: str = "127.0.0.1"
+    port: int = 9092          # 0 = OS-assigned (tests bind ephemeral)
+
+
+@dataclass
+class SloSettings:
+    """SLO objectives + burn-rate alerting thresholds over the per-RPC
+    request/duration families (``observability/slo.py``).  Burn rates are
+    computed over the standard multi-window pairs (5m/1h fast, 30m/6h
+    slow); a page fires only when BOTH windows of a pair exceed the
+    pair's threshold.  See ``docs/operations.md`` §"Ops plane & SLOs"."""
+
+    availability_target: float = 0.999  # fraction of requests that must
+                                        # succeed (99.9%)
+    latency_ms: str = ""          # per-RPC mean-latency targets as
+                                  # "Rpc=ms" pairs, comma-separated
+                                  # (e.g. "VerifyProof=250,Register=100");
+                                  # empty = built-in per-class defaults
+    fast_burn_threshold: float = 14.4  # page when 5m AND 1h burn >= this
+    slow_burn_threshold: float = 6.0   # page when 30m AND 6h burn >= this
+    tick_interval_ms: float = 5000.0   # engine sampling cadence
+
+    def parsed_latency_ms(self) -> dict[str, float]:
+        """{rpc: target ms} overrides from the config string."""
+        out: dict[str, float] = {}
+        text = self.latency_ms.strip()
+        if not text:
+            return out
+        for part in text.split(","):
+            part = part.strip()
+            if not part:
+                continue
+            rpc, _, value = part.partition("=")
+            if not rpc.strip() or not value.strip():
+                raise ValueError(f"malformed latency_ms entry: {part!r}")
+            out[rpc.strip()] = float(value)
+        return out
+
+
+@dataclass
 class ObservabilitySettings:
     """Tracing/telemetry knobs (observability subsystem): the JSON log
     formatter opt-in, the slow-request WARNING threshold, the completed-
@@ -256,9 +306,23 @@ class ServerConfig:
         default_factory=ReplicationSettings
     )
     audit: AuditSettings = field(default_factory=AuditSettings)
+    opsplane: OpsplaneSettings = field(default_factory=OpsplaneSettings)
+    slo: SloSettings = field(default_factory=SloSettings)
 
     def addr(self) -> str:
         return f"{self.host}:{self.port}"
+
+    def fingerprint(self) -> str:
+        """Stable 12-hex digest of the fully-resolved config — the
+        ``config_fingerprint`` row of the ops plane's ``/statusz``, so an
+        operator can tell at a glance whether two boxes (or a box and a
+        deploy manifest) are running the same configuration."""
+        import dataclasses
+        import hashlib
+        import json
+
+        doc = json.dumps(dataclasses.asdict(self), sort_keys=True)
+        return hashlib.sha256(doc.encode()).hexdigest()[:12]
 
     # --- loading (config.rs:218-232 precedence) ---
 
@@ -291,6 +355,8 @@ class ServerConfig:
             ("durability", self.durability),
             ("replication", self.replication),
             ("audit", self.audit),
+            ("opsplane", self.opsplane),
+            ("slo", self.slo),
         ):
             for key, value in data.get(section, {}).items():
                 if hasattr(obj, key):
@@ -445,6 +511,24 @@ class ServerConfig:
             self.replication.epoch_file = v
         if (v := get("REPLICATION_SHARDS")) is not None:
             self.replication.shards = int(v)
+        # ops plane knobs (HTTP introspection server)
+        if (v := get("OPSPLANE_ENABLED")) is not None:
+            self.opsplane.enabled = v.lower() in ("1", "true", "yes", "on")
+        if (v := get("OPSPLANE_HOST")) is not None:
+            self.opsplane.host = v
+        if (v := get("OPSPLANE_PORT")) is not None:
+            self.opsplane.port = int(v)
+        # SLO knobs (burn-rate engine behind the ops plane's /slo)
+        if (v := get("SLO_AVAILABILITY_TARGET")) is not None:
+            self.slo.availability_target = float(v)
+        if (v := get("SLO_LATENCY_MS")) is not None:
+            self.slo.latency_ms = v
+        if (v := get("SLO_FAST_BURN_THRESHOLD")) is not None:
+            self.slo.fast_burn_threshold = float(v)
+        if (v := get("SLO_SLOW_BURN_THRESHOLD")) is not None:
+            self.slo.slow_burn_threshold = float(v)
+        if (v := get("SLO_TICK_INTERVAL_MS")) is not None:
+            self.slo.tick_interval_ms = float(v)
         # audit knobs (proof-log trail behind the bulk audit pipeline)
         if (v := get("AUDIT_ENABLED")) is not None:
             self.audit.enabled = v.lower() in ("1", "true", "yes", "on")
@@ -614,6 +698,32 @@ class ServerConfig:
                     "replication on the primary requires peer (the "
                     "standby's gRPC address)"
                 )
+        if not 0 <= self.opsplane.port <= 65535:
+            raise ValueError(
+                "opsplane.port must be in [0, 65535] (0 = OS-assigned)"
+            )
+        if self.opsplane.enabled and not self.opsplane.host:
+            raise ValueError("opsplane.enabled requires a host to bind")
+        if not 0.0 < self.slo.availability_target < 1.0:
+            raise ValueError(
+                "slo.availability_target must be in (0, 1) — 1.0 leaves "
+                "zero error budget and every failure pages"
+            )
+        if self.slo.fast_burn_threshold <= 0:
+            raise ValueError("slo.fast_burn_threshold must be positive")
+        if self.slo.slow_burn_threshold <= 0:
+            raise ValueError("slo.slow_burn_threshold must be positive")
+        if self.slo.tick_interval_ms <= 0:
+            raise ValueError("slo.tick_interval_ms must be positive")
+        try:
+            latency_targets = self.slo.parsed_latency_ms()
+        except ValueError:
+            raise ValueError(
+                "slo.latency_ms must be comma-separated Rpc=ms pairs "
+                '(e.g. "VerifyProof=250,Register=100")'
+            ) from None
+        if any(ms <= 0 for ms in latency_targets.values()):
+            raise ValueError("slo.latency_ms targets must be positive")
         if self.audit.fsync not in ("always", "interval", "off"):
             raise ValueError(
                 "audit.fsync must be one of: always, interval, off"

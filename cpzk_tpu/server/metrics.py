@@ -229,9 +229,87 @@ def registered() -> list[tuple[str, str]]:
 def start_exporter(host: str, port: int) -> bool:
     """Serve the Prometheus scrape endpoint (bin/server.rs:194-206 twin).
 
-    Returns False when prometheus_client is unavailable.
+    Returns False when prometheus_client is unavailable — the daemon then
+    serves :func:`render_exposition` through the ops plane instead (and
+    says so loudly), rather than silently leaving a configured metrics
+    port with no listener.
     """
     if not HAVE_PROMETHEUS:
         return False
     _start_http_server(port, addr=host)
     return True
+
+
+# -- text exposition (the ops plane's /metrics body) --------------------------
+
+
+def _escape_label(value: str) -> str:
+    return (
+        str(value)
+        .replace("\\", "\\\\")
+        .replace('"', '\\"')
+        .replace("\n", "\\n")
+    )
+
+
+def _format_sample(name: str, labels: dict, value: float) -> str:
+    if labels:
+        body = ",".join(
+            f'{k}="{_escape_label(v)}"' for k, v in sorted(labels.items())
+        )
+        return f"{name}{{{body}}} {value}"
+    return f"{name} {value}"
+
+
+def _noop_samples(kind: str, name: str, child: "_NoopMetric",
+                  labels: dict) -> list[str]:
+    if kind == "c":
+        return [_format_sample(name + "_total", labels, child._value.get())]
+    if kind == "g":
+        return [_format_sample(name, labels, child._value.get())]
+    count, total = child._count.get(), child._sum.get()
+    return [
+        _format_sample(
+            name + "_bucket", {**labels, "le": "+Inf"}, count
+        ),
+        _format_sample(name + "_count", labels, count),
+        _format_sample(name + "_sum", labels, total),
+    ]
+
+
+def render_exposition() -> str:
+    """Prometheus/OpenMetrics-style text exposition rendered from THIS
+    facade's registry, on either backing.
+
+    With ``prometheus_client`` present, each metric's own ``collect()``
+    supplies the samples (full bucket vectors included); without it, the
+    no-op backing renders the counts/gauges/histogram count+sum it
+    already tracks — so the family set is identical either way, and the
+    no-prometheus fallback finally has real exposition instead of
+    nothing (the ops plane's ``/metrics`` serves this string)."""
+    lines: list[str] = []
+    for key in sorted(_REGISTRY, key=lambda k: k.partition(":")[2]):
+        kind, _, name = key.partition(":")
+        metric = _REGISTRY[key]
+        sname = _sanitize(name)
+        kind_word = {"c": "counter", "g": "gauge", "h": "histogram"}[kind]
+        lines.append(f"# HELP {sname} {kind_word} {name}")
+        lines.append(f"# TYPE {sname} {kind_word}")
+        if HAVE_PROMETHEUS:
+            for family in metric.collect():  # type: ignore[attr-defined]
+                for s in family.samples:
+                    lines.append(
+                        _format_sample(s.name, dict(s.labels), s.value)
+                    )
+        else:
+            noop: _NoopMetric = metric  # type: ignore[assignment]
+            if noop._labelnames:
+                for key_values, child in sorted(noop._children.items()):
+                    labels = dict(
+                        zip(noop._labelnames, key_values, strict=True)
+                    )
+                    lines.extend(_noop_samples(kind, sname, child, labels))
+            else:
+                lines.extend(_noop_samples(kind, sname, noop, {}))
+    lines.append("# EOF")
+    return "\n".join(lines) + "\n"

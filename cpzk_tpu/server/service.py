@@ -97,6 +97,39 @@ class AuthServiceImpl:
         # in-flight audit-log fsync tasks (handles kept: a dropped task
         # handle both leaks exceptions and trips ASYNC-002)
         self._audit_flushes: set[asyncio.Task] = set()
+        # live VerifyProofStream registry behind the ops plane's /statusz
+        # per-stream rows and the auth.stream.active gauge
+        self._streams: dict[int, dict] = {}
+        self._stream_seq = 0
+
+    # --- stream registry (ops plane introspection seam) -------------------
+
+    def _stream_open(self, client: str, trace_id: str) -> dict:
+        self._stream_seq += 1
+        info = {
+            "id": self._stream_seq,
+            "client": client,
+            "trace_id": trace_id,
+            "opened_unix": time.time(),
+            "chunks": 0,
+            "entries": 0,
+            "inflight": 0,
+        }
+        self._streams[info["id"]] = info
+        metrics.gauge("auth.stream.active").set(len(self._streams))
+        return info
+
+    def _stream_close(self, info: dict) -> None:
+        self._streams.pop(info["id"], None)
+        metrics.gauge("auth.stream.active").set(len(self._streams))
+
+    def stream_stats(self) -> dict:
+        """Active VerifyProofStream sessions (the ``streams`` block of
+        the ops plane's ``/statusz``)."""
+        return {
+            "active": len(self._streams),
+            "streams": [dict(info) for info in self._streams.values()],
+        }
 
     # --- helpers ---
 
@@ -656,6 +689,7 @@ class AuthServiceImpl:
             )
         client = client_key(context)  # read once at stream open
         rctx = self._request_context(context)
+        stream_info = self._stream_open(client, rctx.trace_id)
         pushback_ms = 0
 
         def note_pushback(ms: int) -> None:
@@ -686,6 +720,9 @@ class AuthServiceImpl:
                         request, client, rctx, note_pushback
                     )
                     inflight += work.size
+                    stream_info["chunks"] += 1
+                    stream_info["entries"] += len(work.ids)
+                    stream_info["inflight"] = inflight
                     unsettled.add(work)
                     out_q.put_nowait(work)
             finally:
@@ -702,9 +739,11 @@ class AuthServiceImpl:
                 async with cond:
                     inflight -= work.size
                     cond.notify_all()
+                stream_info["inflight"] = inflight
                 yield resp
             await reader_task  # surface a reader-side transport error
         finally:
+            self._stream_close(stream_info)
             # client gone / handler torn down with chunks in flight:
             # cancel the reader and every unsettled verify task so no
             # batcher future leaks (cancelled chunk futures are shed as
@@ -1056,6 +1095,7 @@ async def serve(
         health.standby = replica.role != "primary"
         replica.health = health  # promotion flips readiness to SERVING
     server.health = health  # for shutdown: server.health.serving = False
+    server.auth_service = service  # ops plane: /statusz stream rows
     server.batcher = batcher
     server.admission = admission
     server.replica = replica

@@ -45,6 +45,7 @@ from dataclasses import dataclass, field
 
 from ..errors import InvalidParams
 from ..protocol.gadgets import Statement
+from . import metrics
 
 CHALLENGE_EXPIRY_SECONDS = 300
 MAX_CHALLENGES_PER_USER = 3
@@ -134,6 +135,36 @@ class SessionData:
         return now >= self.expires_at or age >= 2 * SESSION_EXPIRY_SECONDS
 
 
+#: Every Nth shard-lock acquisition is timed into the
+#: ``state.shard.lock_wait`` histogram (uniform stride, so the mean an
+#: operator reads is unbiased; per-acquire timing on the serving path
+#: would cost two clock reads per state op for a signal that only
+#: matters in aggregate).
+_LOCK_WAIT_STRIDE = 16
+
+
+class _SampledLock(asyncio.Lock):
+    """An ``asyncio.Lock`` that stride-samples acquisition wait into the
+    cross-plane ``state.shard.lock_wait`` histogram — the shard-contention
+    signal the ops plane's ``/statusz`` surfaces.  Drop-in: every
+    ``async with shard.lock`` site stays untouched."""
+
+    def __init__(self) -> None:
+        super().__init__()
+        self._acquires = 0
+
+    async def acquire(self) -> bool:
+        self._acquires += 1
+        if self._acquires % _LOCK_WAIT_STRIDE:
+            return await super().acquire()
+        t0 = time.monotonic()
+        result = await super().acquire()
+        metrics.histogram("state.shard.lock_wait").observe(
+            time.monotonic() - t0
+        )
+        return result
+
+
 class StateShard:
     """One lock + the five registries it guards, for one hash slice of the
     user keyspace.  Everything about a user — registration, challenges,
@@ -146,7 +177,7 @@ class StateShard:
     )
 
     def __init__(self) -> None:
-        self.lock = asyncio.Lock()
+        self.lock = _SampledLock()
         self._users: dict[str, UserData] = {}
         self._challenges: dict[bytes, ChallengeData] = {}
         self._user_challenges: dict[str, list[bytes]] = {}
@@ -314,6 +345,34 @@ class ServerState:
 
     def _total_sessions(self) -> int:
         return sum(len(s._sessions) for s in self._shards)
+
+    # --- per-shard introspection (ops plane /statusz + /metrics) ----------
+
+    def shard_stats(self) -> list[dict]:
+        """Per-shard registry sizes, shard-index order.  Synchronous dict
+        ``len()`` reads — a consistent-enough cut for an operator surface,
+        with zero lock traffic on the serving path."""
+        return [
+            {
+                "shard": i,
+                "users": len(s._users),
+                "sessions": len(s._sessions),
+                "challenges": len(s._challenges),
+            }
+            for i, s in enumerate(self._shards)
+        ]
+
+    def export_shard_gauges(self) -> None:
+        """Refresh the per-shard ``state.shard.size{shard,kind}`` gauges
+        (pull-style: called by the ops plane right before an exposition
+        render rather than on every mutation — per-mutation gauge writes
+        would tax the serving path for a scrape-time number)."""
+        gauge = metrics.gauge("state.shard.size", labelnames=("shard", "kind"))
+        for row in self.shard_stats():
+            idx = str(row["shard"])
+            gauge.labels(shard=idx, kind="users").set(row["users"])
+            gauge.labels(shard=idx, kind="sessions").set(row["sessions"])
+            gauge.labels(shard=idx, kind="challenges").set(row["challenges"])
 
     # --- merged views (test/inspection seam; RPC paths use shards) --------
 

@@ -615,7 +615,10 @@ def test_format_flightrec_limit():
         FlightRecord(seq=i, batch=i, stages_s={}, wall_s=0.001)
         for i in range(1, 6)
     ]
-    out = format_flightrec(records, limit=2)
+    # the REPL consumes the same serialized payload shape the HTTP
+    # /flightrec endpoint and the SIGUSR2 dump emit
+    payload = {"records": [r.to_dict() for r in records]}
+    out = format_flightrec(payload, limit=2)
     assert "#5" in out and "#4" in out and "#3" not in out
     for name in RECORD_STAGES:
         assert f"{name}=" in out

@@ -143,6 +143,15 @@ assert metrics.read("tpu.device.busy_fraction", "g") > 0.0
 rec.record(flightrec.FlightRecord(batch=8, lanes=16, occupancy=0.5))
 assert metrics.read("tpu.batch.occupancy", "g") == 0.5
 assert metrics.read("tpu.throughput.proofs_per_s", "g") >= 0.0
+
+# the ops plane's text exposition works on the no-op backing too, with
+# the identical family set the prometheus backing would render
+text = metrics.render_exposition()
+for _kind, name in metrics.registered():
+    assert metrics._sanitize(name) in text, name
+assert "noop_test_count_total 3.0" in text
+assert 'noop_test_labeled_total{rpc="X"} 1.0' in text
+assert text.rstrip().endswith("# EOF")
 print("NOOP-OK")
 """
 

@@ -310,13 +310,16 @@ def test_tracer_span_on_unknown_trace_is_dropped():
 
 
 def test_format_tracez_empty_and_limit():
-    assert "no completed traces" in format_tracez([])
+    assert "no completed traces" in format_tracez({"traces": []})
     tracer = get_tracer()
     for i in range(5):
         ctx = RequestContext()
         tracer.start(ctx, f"op{i}")
         tracer.finish(ctx.trace_id, "success")
-    out = format_tracez(tracer.completed(), limit=2)
+    # the REPL renders the same payload the HTTP /tracez serves
+    payload = tracer.payload()
+    assert payload["schema"] == "cpzk-tracez/1"
+    out = format_tracez(payload, limit=2)
     assert "op4" in out and "op3" in out and "op2" not in out
 
 
