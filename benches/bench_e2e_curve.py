@@ -110,9 +110,18 @@ async def grpc_curve_point(
                              pipeline_depth=2)  # serve() starts it
 
     state = ServerState()
+    # CPZK_BENCH_FLEET=1: enable fleet routing with a single-partition
+    # map — the perf gate's proof that the N=1 ownership fast path taxes
+    # the serving hot path by nothing measurable (the address is a
+    # placeholder: a one-partition router never redirects)
+    fleet = None
+    if os.environ.get("CPZK_BENCH_FLEET"):
+        from cpzk_tpu.fleet import FleetRouter, PartitionMap
+
+        fleet = FleetRouter(PartitionMap.uniform(["127.0.0.1:0"]), 0)
     server, port = await serve(
         state, RateLimiter(10**9, 10**9), host="127.0.0.1", port=0,
-        backend=backend, batcher=batcher,
+        backend=backend, batcher=batcher, fleet=fleet,
     )
     # CPZK_BENCH_OPSPLANE=1: run the full HTTP introspection server +
     # SLO engine alongside the timed passes — the perf gate's proof that

@@ -169,7 +169,10 @@ def build_parser() -> argparse.ArgumentParser:
     g.set_defaults(fn=cmd_generate)
 
     r = sub.add_parser("run", help="replay a proof log, write a signed report")
-    r.add_argument("--log", required=True)
+    r.add_argument("--log", required=True,
+                   help="the proof log file, or a rotated-segment "
+                        "directory (sealed *.seg files + active tail "
+                        "replay as one log)")
     r.add_argument("--report", required=True)
     r.add_argument("--cursor", default=None,
                    help="checkpoint path (default <report>.cursor)")

@@ -31,6 +31,7 @@ by design; rotate by pointing ``[audit] log_path`` somewhere new.
 from __future__ import annotations
 
 import os
+import re
 import threading
 import time
 
@@ -43,6 +44,8 @@ __all__ = [
     "proof_record",
     "read_log",
     "scan_records",
+    "sealed_segments",
+    "segment_name",
     "validate_proof_record",
 ]
 
@@ -124,6 +127,45 @@ def read_log(path: str) -> tuple[list[dict], int, int]:
     return records, valid, len(raw)
 
 
+#: Sealed-segment name template: zero-padded first/last sequence numbers
+#: so lexicographic order equals sequence order.
+_SEG_WIDTH = 12
+_SEG_SUFFIX = ".seg"
+_SEG_RE = re.compile(r"\.(\d{12})-(\d{12})\.seg$")
+
+
+def segment_name(path: str, first_seq: int, last_seq: int) -> str:
+    return (
+        f"{path}.{first_seq:0{_SEG_WIDTH}d}-{last_seq:0{_SEG_WIDTH}d}"
+        f"{_SEG_SUFFIX}"
+    )
+
+
+def _segment_seq_range(seg_path: str) -> tuple[int, int]:
+    m = _SEG_RE.search(seg_path)
+    if m is None:
+        raise ValueError(f"not a sealed proof-log segment name: {seg_path!r}")
+    return int(m.group(1)), int(m.group(2))
+
+
+def sealed_segments(path: str) -> list[str]:
+    """Sealed-segment files rotated out of the log at ``path``, sequence
+    order (their zero-padded names sort that way)."""
+    d = os.path.dirname(os.path.abspath(path)) or "."
+    base = os.path.basename(path)
+    try:
+        names = os.listdir(d)
+    except OSError:
+        return []
+    out = [
+        os.path.join(d, n)
+        for n in names
+        if n.startswith(base + ".") and _SEG_RE.search(n)
+    ]
+    out.sort()
+    return out
+
+
 class ProofLogWriter:
     """Append-only framed proof log with a configurable fsync policy.
 
@@ -133,6 +175,16 @@ class ProofLogWriter:
     wants one — happens in :meth:`sync` on a worker thread.  Created
     0600: the log carries statements and challenge ids (public-ish), but
     an audit trail's integrity expectations match the WAL's.
+
+    **Rotation** (``segment_bytes > 0``): once the active file reaches
+    the threshold it is force-synced and atomically renamed to
+    ``<path>.<first_seq>-<last_seq>.seg`` (zero-padded, so lexicographic
+    order IS sequence order) and a fresh active file opens.  Sealed
+    segments are immutable; the replication plane ships them to the warm
+    standby (``SegmentShipper``), so a machine death loses at most the
+    unsealed active tail — the proof log survives hardware the way the
+    WAL does.  ``python -m cpzk_tpu.audit run`` accepts the directory of
+    rotated segments directly.
     """
 
     def __init__(
@@ -140,12 +192,16 @@ class ProofLogWriter:
         path: str,
         fsync: str = "off",
         fsync_interval_ms: float = 200.0,
+        segment_bytes: int = 0,
     ):
         if fsync not in ("always", "interval", "off"):
             raise ValueError(f"unknown proof-log fsync policy: {fsync!r}")
+        if segment_bytes < 0:
+            raise ValueError("segment_bytes cannot be negative")
         self.path = path
         self.policy = fsync
         self.interval_s = fsync_interval_ms / 1000.0
+        self.segment_bytes = segment_bytes
         self._lock = threading.Lock()
         self._fd: int | None = os.open(
             path, os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o600
@@ -153,16 +209,24 @@ class ProofLogWriter:
         os.chmod(path, 0o600)
         self.size = os.fstat(self._fd).st_size
         # resume numbering past an existing log so an appended-to log
-        # still satisfies the strictly-increasing-seq prefix contract
+        # still satisfies the strictly-increasing-seq prefix contract.
+        # With rotation, sealed segments hold the earlier history — the
+        # active file resumes past the LAST sealed segment too.
         self.seq = 0
+        for seg in self.sealed_segments():
+            _first, last = _segment_seq_range(seg)
+            self.seq = max(self.seq, last)
+        self.file_first_seq = self.seq + 1
         if self.size:
             try:
                 records, _, _ = read_log(path)
                 if records:
-                    self.seq = int(records[-1]["seq"])
+                    self.file_first_seq = int(records[0]["seq"])
+                    self.seq = max(self.seq, int(records[-1]["seq"]))
             except OSError:  # pragma: no cover - racing rotation
                 pass
         self.records = 0
+        self.rotations = 0
         self._pending = 0
         self._last_fsync = time.monotonic()
 
@@ -194,7 +258,38 @@ class ProofLogWriter:
             self._pending += len(payloads)
             metrics.counter("audit.log.appends").inc(len(payloads))
             metrics.counter("audit.log.bytes").inc(len(frames))
+            if self.segment_bytes and self.size >= self.segment_bytes:
+                self._rotate_locked()
             return self.seq
+
+    def _rotate_locked(self) -> None:
+        """Seal the active file (fsync + atomic rename to
+        ``<path>.<first>-<last>.seg``) and open a fresh one.  Caller
+        holds ``_lock``.  Zero-padded seq range in the name keeps
+        lexicographic order equal to sequence order — the shipper and
+        the audit pipeline both lean on that."""
+        assert self._fd is not None
+        os.fsync(self._fd)  # a sealed segment is durable by definition
+        os.close(self._fd)
+        self._fd = None
+        sealed = segment_name(self.path, self.file_first_seq, self.seq)
+        os.replace(self.path, sealed)
+        self._fd = os.open(
+            self.path, os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o600
+        )
+        os.chmod(self.path, 0o600)
+        self.size = 0
+        self._pending = 0
+        self._last_fsync = time.monotonic()
+        self.file_first_seq = self.seq + 1
+        self.rotations += 1
+        metrics.counter("audit.log.rotations").inc()
+
+    def sealed_segments(self) -> list[str]:
+        """Sealed-segment paths for this log, sequence order (the
+        shipper's work list; survives restarts — it is a directory
+        scan, not in-memory state)."""
+        return sealed_segments(self.path)
 
     def needs_sync(self) -> bool:
         """Whether :meth:`sync` would fsync right now under the policy —
@@ -239,6 +334,9 @@ class ProofLogWriter:
                 "records_this_boot": self.records,
                 "pending_appends": self._pending,
                 "fsync_policy": self.policy,
+                "segment_bytes": self.segment_bytes,
+                "rotations_this_boot": self.rotations,
+                "sealed_segments": len(self.sealed_segments()),
             }
 
     def close(self) -> None:

@@ -178,6 +178,45 @@ def _atomic_write_json(path: str, obj: dict) -> None:
         raise
 
 
+def log_files(log_path: str) -> list[str]:
+    """The ordered file list of one logical proof log: the path itself,
+    or — when it is a rotated-segment **directory** — every sealed
+    ``*.seg`` file in name order (zero-padded names sort in sequence
+    order) followed by the active log file(s) they rotated out of.
+    Sequence numbers strictly increase across that concatenation, so the
+    WAL prefix scan treats it as one log."""
+    if not os.path.isdir(log_path):
+        return [log_path]
+    from .log import _SEG_RE
+
+    names = sorted(os.listdir(log_path))
+    segs = [n for n in names if _SEG_RE.search(n)]
+    bases: list[str] = []
+    for n in segs:
+        base = _SEG_RE.sub("", n)
+        if base not in bases:
+            bases.append(base)
+    files = [os.path.join(log_path, n) for n in segs]
+    files += [
+        os.path.join(log_path, b) for b in sorted(bases)
+        if os.path.isfile(os.path.join(log_path, b))
+    ]
+    if not files:
+        raise ValueError(
+            f"{log_path} is a directory with no proof-log segments "
+            "(*.seg) in it"
+        )
+    return files
+
+
+def _read_log_bytes(log_path: str) -> bytes:
+    parts = []
+    for path in log_files(log_path):
+        with open(path, "rb") as f:
+            parts.append(f.read())
+    return b"".join(parts)
+
+
 def build_backend(backend_name: str, mesh_devices: int = 0):
     """The audit compute plane: the CPU oracle, or the mesh-sharded TPU
     backend (``mesh_devices`` semantics shared with serving: 0 = all
@@ -240,6 +279,12 @@ def run_audit(
 
     ``cursor_path`` defaults to ``<report_path>.cursor``; ``key_path``
     defaults to ``<report_path>.key`` (minted 0600 when absent).
+
+    ``log_path`` may be a **rotated-segment directory** (a log written
+    with ``[audit] segment_bytes`` — or a standby's shipped copy): the
+    sealed ``*.seg`` files plus the active tail replay as one logical
+    log, cursor offsets indexing into their concatenation (stable:
+    sealing only renames bytes in place within the order).
     """
     if quantum < 1:
         raise ValueError("audit quantum must be positive")
@@ -250,8 +295,7 @@ def run_audit(
         with open(cursor_path, encoding="utf-8") as f:
             state = AuditState.from_cursor(json.load(f), log_path)
 
-    with open(log_path, "rb") as f:
-        buf = f.read()
+    buf = _read_log_bytes(log_path)
     if state.offset > len(buf):
         raise ValueError(
             f"cursor offset {state.offset} is beyond the log "

@@ -22,6 +22,11 @@ from dataclasses import dataclass
 
 import grpc
 
+from ..fleet.partition_map import (
+    PARTITION_MAP_VERSION_KEY,
+    PARTITION_OWNER_KEY,
+    PartitionMap,
+)
 from ..observability.context import RequestContext
 from ..resilience.retry import RETRY_PUSHBACK_KEY, RetryPolicy
 from ..server.proto import SERVICE_NAME, load_pb2, method_types, stream_method_types
@@ -34,6 +39,11 @@ _RETRY_SAFE = frozenset({"Register", "RegisterBatch", "CreateChallenge", "Health
 #: Metadata tag carrying the caller's self-chosen identity for per-client
 #: fair admission (see cpzk_tpu.admission.limiter.client_key).
 CLIENT_ID_KEY = "cpzk-client-id"
+
+#: Hard cap on wrong-partition re-routes within one logical call: the
+#: contract is one refresh + re-route per attempt, and a second redirect
+#: in a row means the fleet's maps are churning — surface the error.
+_MAX_REDIRECTS = 2
 
 
 def _pushback_ms(err) -> float | None:
@@ -56,6 +66,33 @@ def _pushback_ms(err) -> float | None:
     return None
 
 
+def _redirect_info(err) -> tuple[str | None, int | None]:
+    """``(owner_address, map_version)`` from a wrong-partition
+    FAILED_PRECONDITION's trailing metadata, or ``(None, None)`` when the
+    error is not a fleet redirect.  Both trailers must be present — a
+    plain FAILED_PRECONDITION from anything else is never re-routed."""
+    try:
+        trailing = err.trailing_metadata()
+    except Exception:
+        return None, None
+    owner: str | None = None
+    version: int | None = None
+    for key, value in trailing or ():
+        k = str(key).lower()
+        if isinstance(value, bytes):
+            value = value.decode("utf-8", "replace")
+        if k == PARTITION_OWNER_KEY:
+            owner = str(value)
+        elif k == PARTITION_MAP_VERSION_KEY:
+            try:
+                version = int(value)
+            except (TypeError, ValueError):
+                version = None
+    if owner is None or version is None:
+        return None, None
+    return owner, version
+
+
 @dataclass(slots=True)
 class StreamVerdict:
     """One per-proof outcome from :meth:`AuthClient.verify_proof_stream`.
@@ -72,15 +109,26 @@ class StreamVerdict:
 
 
 class AuthClient:
-    """Thin unary-unary stub set over a grpc.aio channel."""
+    """Thin unary-unary stub set over a grpc.aio channel — or, with a
+    :class:`~cpzk_tpu.fleet.PartitionMap`, over a **channel pool keyed by
+    partition**: user-keyed RPCs route to the owning partition's address,
+    batch RPCs fan out per partition, and a wrong-partition redirect
+    (``FAILED_PRECONDITION`` + the map-version/owner trailers) triggers
+    at most ONE map refresh + re-route per attempt, charged against the
+    retry budget.  ``VerifyProof`` — never retried on any other error,
+    because its challenge is consumed server-side on first receipt — IS
+    safely re-routed here: the server checks ownership *before* touching
+    state, so a redirected proof's challenge was never consumed."""
 
     def __init__(
         self,
-        target: str,
+        target: str = "",
         credentials: grpc.ChannelCredentials | None = None,
         retry: RetryPolicy | None = None,
         retry_rng: random.Random | None = None,
         client_id: str | None = None,
+        partition_map: PartitionMap | None = None,
+        map_refresh=None,
     ):
         self.pb2 = load_pb2()
         self.retry = retry
@@ -90,20 +138,34 @@ class AuthClient:
         self.client_id = client_id
         #: trace context of the most recent RPC attempt (observability).
         self.last_context: RequestContext | None = None
+        #: the routing map (None = single-target client, exactly as
+        #: before); refreshed in place on a server redirect when
+        #: ``map_refresh`` is provided.
+        self.partition_map = partition_map
+        #: zero-arg callable (sync or async) returning a fresh
+        #: :class:`PartitionMap` or None — typically a fetch of the ops
+        #: plane's ``/partitionmap``; invoked at most once per redirect.
+        self.map_refresh = map_refresh
+        #: wrong-partition re-routes performed (observability/tests).
+        self.redirects = 0
         # injectable RNG so chaos tests get deterministic jitter
         self._retry_rng = retry_rng or random.Random()
-        if credentials is not None:
-            self.channel = grpc.aio.secure_channel(target, credentials)
-        else:
-            self.channel = grpc.aio.insecure_channel(target)
+        self._credentials = credentials
+        if not target:
+            if partition_map is None:
+                raise ValueError(
+                    "AuthClient needs a target or a partition_map"
+                )
+            target = partition_map.partitions[0].address
+        self._target = target
+        # per-partition channel pool; the default target's channel lives
+        # in it too, so `self.channel` stays one of the pooled channels
+        self._pool: dict[str, grpc.aio.Channel] = {}
+        self._unary_stubs: dict[tuple[str, str], object] = {}
+        self.channel = self._channel(target)
         types = method_types(self.pb2)
         self._stubs = {
-            name: self.channel.unary_unary(
-                f"/{SERVICE_NAME}/{name}",
-                request_serializer=req.SerializeToString,
-                response_deserializer=resp.FromString,
-            )
-            for name, (req, resp) in types.items()
+            name: self._stub(target, name) for name in types
         }
         stream_types = stream_method_types(self.pb2)
         req, resp = stream_types["VerifyProofStream"]
@@ -113,8 +175,62 @@ class AuthClient:
             response_deserializer=resp.FromString,
         )
 
+    # --- the per-partition channel pool ---
+
+    def _channel(self, address: str) -> grpc.aio.Channel:
+        ch = self._pool.get(address)
+        if ch is None:
+            if self._credentials is not None:
+                ch = grpc.aio.secure_channel(address, self._credentials)
+            else:
+                ch = grpc.aio.insecure_channel(address)
+            self._pool[address] = ch
+        return ch
+
+    def _stub(self, address: str, name: str):
+        key = (address, name)
+        stub = self._unary_stubs.get(key)
+        if stub is None:
+            req, resp = method_types(self.pb2)[name]
+            stub = self._channel(address).unary_unary(
+                f"/{SERVICE_NAME}/{name}",
+                request_serializer=req.SerializeToString,
+                response_deserializer=resp.FromString,
+            )
+            self._unary_stubs[key] = stub
+        return stub
+
+    def _route_address(self, user_id: str) -> str:
+        """The owning partition's address under the client's map (the
+        default target when no map is loaded)."""
+        if self.partition_map is None:
+            return self._target
+        return self.partition_map.partition_for(user_id).address
+
+    async def _refresh_map(self) -> bool:
+        """One bounded map refresh (called on a redirect): adopt the
+        fetched map when its version is strictly newer.  A refresh
+        failure is non-fatal — the redirect's owner trailer still routes
+        this attempt."""
+        fn = self.map_refresh
+        if fn is None:
+            return False
+        try:
+            fresh = fn()
+            if asyncio.iscoroutine(fresh):
+                fresh = await fresh
+        except Exception:
+            return False
+        if fresh is None or self.partition_map is None:
+            return False
+        if fresh.version > self.partition_map.version:
+            self.partition_map = fresh
+            return True
+        return False
+
     async def close(self) -> None:
-        await self.channel.close()
+        for ch in self._pool.values():
+            await ch.close()
 
     async def __aenter__(self) -> "AuthClient":
         return self
@@ -124,17 +240,34 @@ class AuthClient:
 
     # --- retry plumbing ---
 
-    async def _call(self, name: str, stub, request, timeout: float | None):
-        """One RPC through the retry policy.  Non-idempotent methods (and
-        clients with no policy) go straight through; the rest retry only
-        on the policy's transient codes, sleeping full-jitter backoff,
-        until attempts or the shared budget run out.
+    async def _call(
+        self, name: str, stub, request, timeout: float | None,
+        user_id: str | None = None,
+    ):
+        """One RPC through the routing + retry stack.
+
+        **Routing** (fleet mode only — a ``partition_map`` is loaded):
+        ``user_id``-keyed RPCs resolve the owning partition's address per
+        attempt and go out on that partition's pooled channel.  A
+        wrong-partition rejection (``FAILED_PRECONDITION`` carrying the
+        map-version + owner trailers) triggers at most one map refresh +
+        re-route per attempt — charged against the shared retry budget,
+        capped at ``_MAX_REDIRECTS`` per logical call — which is how a
+        stale-map client converges in one extra round trip.  This applies
+        to EVERY routed RPC including ``VerifyProof``: the server checks
+        ownership before consuming anything, so a redirected proof is not
+        a replay.
+
+        **Retries**: non-idempotent methods (and clients with no policy)
+        go straight through; the rest retry only on the policy's
+        transient codes, sleeping full-jitter backoff, until attempts or
+        the shared budget run out.
 
         Every attempt carries a trace context in its gRPC metadata: the
         trace id is minted ONCE per logical call and stays stable across
-        retries while the attempt number increments, so the server-side
-        trace ring shows a retried request as one trace with several
-        completions.  The most recent context is kept on
+        retries/redirects while the attempt number increments, so the
+        server-side trace ring shows a retried request as one trace with
+        several completions.  The most recent context is kept on
         ``self.last_context`` for callers that want to correlate their
         own logs with the server's.
 
@@ -147,10 +280,10 @@ class AuthClient:
         rctx = RequestContext()
         self.last_context = rctx
         policy = self.retry
-        if policy is None or name not in _RETRY_SAFE:
-            return await stub(
-                request, timeout=timeout, metadata=self._metadata(rctx)
-            )
+        routed = self.partition_map is not None and user_id is not None
+        if routed:
+            stub = self._stub(self._route_address(user_id), name)
+        redirected = 0
         while True:
             try:
                 response = await stub(
@@ -159,7 +292,35 @@ class AuthClient:
             except grpc.RpcError as e:
                 code = e.code()
                 code_name = code.name if code is not None else ""
+                if (
+                    self.partition_map is not None
+                    and code_name == "FAILED_PRECONDITION"
+                    and redirected < _MAX_REDIRECTS
+                ):
+                    owner, _version = _redirect_info(e)
+                    if owner is not None:
+                        # one refresh + re-route, against the retry budget
+                        if (
+                            policy is not None
+                            and policy.budget is not None
+                            and not policy.budget.try_withdraw()
+                        ):
+                            raise
+                        redirected += 1
+                        self.redirects += 1
+                        refreshed = await self._refresh_map()
+                        addr = owner
+                        if refreshed and user_id is not None:
+                            # the fresh map may know better than the
+                            # (possibly itself-stale) rejecting server
+                            addr = self._route_address(user_id)
+                        stub = self._stub(addr, name)
+                        rctx = rctx.child()  # same trace id, attempt + 1
+                        self.last_context = rctx
+                        continue
                 pushback = _pushback_ms(e)
+                if policy is None or name not in _RETRY_SAFE:
+                    raise
                 if pushback is not None and pushback < 0:
                     raise  # server pushback: do not retry
                 if not policy.should_retry(code_name, rctx.attempt):
@@ -173,7 +334,8 @@ class AuthClient:
                 rctx = rctx.child()  # same trace id, attempt + 1
                 self.last_context = rctx
                 continue
-            policy.note_success()
+            if policy is not None and name in _RETRY_SAFE:
+                policy.note_success()
             return response
 
     def _metadata(self, rctx: RequestContext):
@@ -190,20 +352,54 @@ class AuthClient:
             self._stubs["Register"],
             self.pb2.RegistrationRequest(user_id=user_id, y1=y1, y2=y2),
             timeout,
+            user_id=user_id,
         )
+
+    def _partition_groups(
+        self, user_ids: list[str]
+    ) -> list[tuple[str, list[int]]] | None:
+        """Batch fan-out plan: ``[(address, [indices]), ...]`` grouping
+        the batch by owning partition under the client's map, or ``None``
+        when no fan-out is needed (no map, or a single partition)."""
+        pmap = self.partition_map
+        if pmap is None or len(pmap.partitions) < 2:
+            return None
+        groups: dict[str, list[int]] = {}
+        for i, uid in enumerate(user_ids):
+            groups.setdefault(pmap.partition_for(uid).address, []).append(i)
+        return list(groups.items())
 
     async def register_batch(
         self, user_ids: list[str], y1_values: list[bytes], y2_values: list[bytes],
         timeout: float | None = None,
     ):
-        return await self._call(
-            "RegisterBatch",
-            self._stubs["RegisterBatch"],
-            self.pb2.BatchRegistrationRequest(
-                user_ids=user_ids, y1_values=y1_values, y2_values=y2_values
-            ),
-            timeout,
-        )
+        groups = self._partition_groups(user_ids)
+        if groups is None:
+            return await self._call(
+                "RegisterBatch",
+                self._stubs["RegisterBatch"],
+                self.pb2.BatchRegistrationRequest(
+                    user_ids=user_ids, y1_values=y1_values, y2_values=y2_values
+                ),
+                timeout,
+            )
+        # fleet fan-out: one sub-batch per owning partition, results
+        # reassembled in the caller's entry order
+        results = [None] * len(user_ids)
+        for address, idxs in groups:
+            resp = await self._call(
+                "RegisterBatch",
+                self._stub(address, "RegisterBatch"),
+                self.pb2.BatchRegistrationRequest(
+                    user_ids=[user_ids[i] for i in idxs],
+                    y1_values=[y1_values[i] for i in idxs],
+                    y2_values=[y2_values[i] for i in idxs],
+                ),
+                timeout,
+            )
+            for k, i in enumerate(idxs):
+                results[i] = resp.results[k]
+        return self.pb2.BatchRegistrationResponse(results=results)
 
     async def create_challenge(self, user_id: str, timeout: float | None = None):
         return await self._call(
@@ -211,13 +407,16 @@ class AuthClient:
             self._stubs["CreateChallenge"],
             self.pb2.ChallengeRequest(user_id=user_id),
             timeout,
+            user_id=user_id,
         )
 
     async def verify_proof(
         self, user_id: str, challenge_id: bytes, proof: bytes, timeout: float | None = None
     ):
         # never retried: the challenge is consumed server-side on first
-        # receipt, so a resend is guaranteed PERMISSION_DENIED
+        # receipt, so a resend is guaranteed PERMISSION_DENIED.  (A fleet
+        # wrong-partition redirect IS re-routed — ownership is checked
+        # before the consume, so nothing was burned.)
         return await self._call(
             "VerifyProof",
             self._stubs["VerifyProof"],
@@ -225,6 +424,7 @@ class AuthClient:
                 user_id=user_id, challenge_id=challenge_id, proof=proof
             ),
             timeout,
+            user_id=user_id,
         )
 
     async def verify_proof_batch(
@@ -232,14 +432,31 @@ class AuthClient:
         timeout: float | None = None,
     ):
         # never retried (same consumed-challenge semantics as VerifyProof)
-        return await self._call(
-            "VerifyProofBatch",
-            self._stubs["VerifyProofBatch"],
-            self.pb2.BatchVerificationRequest(
-                user_ids=user_ids, challenge_ids=challenge_ids, proofs=proofs
-            ),
-            timeout,
-        )
+        groups = self._partition_groups(user_ids)
+        if groups is None:
+            return await self._call(
+                "VerifyProofBatch",
+                self._stubs["VerifyProofBatch"],
+                self.pb2.BatchVerificationRequest(
+                    user_ids=user_ids, challenge_ids=challenge_ids, proofs=proofs
+                ),
+                timeout,
+            )
+        results = [None] * len(user_ids)
+        for address, idxs in groups:
+            resp = await self._call(
+                "VerifyProofBatch",
+                self._stub(address, "VerifyProofBatch"),
+                self.pb2.BatchVerificationRequest(
+                    user_ids=[user_ids[i] for i in idxs],
+                    challenge_ids=[challenge_ids[i] for i in idxs],
+                    proofs=[proofs[i] for i in idxs],
+                ),
+                timeout,
+            )
+            for k, i in enumerate(idxs):
+                results[i] = resp.results[k]
+        return self.pb2.BatchVerificationResponse(results=results)
 
     async def verify_proof_stream(
         self,
@@ -262,6 +479,13 @@ class AuthClient:
         VerifyProof): a transport failure mid-stream surfaces
         immediately — the caller restarts from CreateChallenge for
         whatever entries had no verdict yet.
+
+        Fleet note: a stream rides ONE channel (the default target), so
+        in a multi-partition deployment the driver shards its entry
+        stream per partition itself (``partition_map.partition_for``)
+        and opens one stream per partition; entries for users this
+        partition does not own come back as per-entry wrong-partition
+        failures, never a dead stream.
 
         Convenience wrapper over :meth:`verify_proof_stream_chunks` —
         bulk drivers that count outcomes at 10k+ proofs/s should consume

@@ -1,0 +1,40 @@
+"""Fleet layer: N-partition routing over the replication plane.
+
+ROADMAP item 3: one primary/standby pair (ISSUE 8) scaled out to N
+partitions behind a versioned :class:`PartitionMap` — consumed
+client-side by :class:`~cpzk_tpu.client.AuthClient`, enforced
+server-side by the auth service (wrong-partition RPCs redirect with the
+map version + owner address in trailing metadata), served read-only from
+the ops plane at ``/partitionmap``, and **grown** by the live split flow
+(:mod:`cpzk_tpu.fleet.split`), which moves a hash range's users to a new
+partition through the same ``SegmentApplier`` trust boundary promotion
+already relies on.
+
+CLI: ``python -m cpzk_tpu.fleet init|show|route|split``.
+"""
+
+from .partition_map import (
+    HASH_SPACE,
+    PARTITION_MAP_VERSION_KEY,
+    PARTITION_OWNER_KEY,
+    FleetRouter,
+    Partition,
+    PartitionMap,
+    fetch_partition_map,
+    user_hash,
+)
+from .split import SPLIT_CRASH_POINTS, SplitError, run_split
+
+__all__ = [
+    "HASH_SPACE",
+    "PARTITION_MAP_VERSION_KEY",
+    "PARTITION_OWNER_KEY",
+    "SPLIT_CRASH_POINTS",
+    "FleetRouter",
+    "Partition",
+    "PartitionMap",
+    "SplitError",
+    "fetch_partition_map",
+    "run_split",
+    "user_hash",
+]

@@ -1,0 +1,138 @@
+"""Fleet CLI: build, inspect, and grow the partition map.
+
+Subcommands::
+
+    python -m cpzk_tpu.fleet init --addresses a:1,b:2,c:3 --out map.json
+    python -m cpzk_tpu.fleet show --map map.json
+    python -m cpzk_tpu.fleet route --map map.json USER_ID [USER_ID ...]
+    python -m cpzk_tpu.fleet split --map map.json --source 0 \\
+        --new-address d:4 --source-state p0.json --target-state p3.json
+
+``split`` is crash-resumable: SIGKILL it at any stage and re-running the
+identical command completes the split (see ``fleet/split.py`` and the
+runbook in docs/operations.md §"Partitioned fleet").
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import sys
+
+
+def cmd_init(args) -> int:
+    from .partition_map import PartitionMap
+
+    addresses = [a.strip() for a in args.addresses.split(",") if a.strip()]
+    pmap = PartitionMap.uniform(addresses)
+    pmap.store(args.out)
+    print(json.dumps({
+        "path": args.out, "version": pmap.version,
+        "partitions": len(pmap.partitions), "digest": pmap.short_digest(),
+    }))
+    return 0
+
+
+def cmd_show(args) -> int:
+    from .partition_map import PartitionMap
+
+    pmap = PartitionMap.load(args.map)
+    print(pmap.to_json(), end="")
+    return 0
+
+
+def cmd_route(args) -> int:
+    from .partition_map import PartitionMap, user_hash
+
+    pmap = PartitionMap.load(args.map)
+    for uid in args.user_ids:
+        p = pmap.partition_for(uid)
+        print(json.dumps({
+            "user_id": uid, "hash": user_hash(uid),
+            "partition": p.index, "address": p.address,
+            "map_version": pmap.version,
+        }))
+    return 0
+
+
+def cmd_split(args) -> int:
+    from .split import SplitError, run_split
+
+    try:
+        report = asyncio.run(run_split(
+            args.map, args.source, args.new_address,
+            args.source_state, args.target_state,
+            source_wal=args.source_wal,
+            target_wal=args.target_wal,
+            source_epoch_file=args.source_epoch,
+            target_epoch_file=args.target_epoch,
+            segment_bytes=args.segment_bytes,
+        ))
+    except SplitError as e:
+        print(f"split: {e}", file=sys.stderr)
+        return 2
+    print(json.dumps(report))
+    return 0
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m cpzk_tpu.fleet",
+        description="partition-map fleet tooling",
+    )
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    i = sub.add_parser("init", help="write an initial uniform partition map")
+    i.add_argument("--addresses", required=True,
+                   help="comma-separated partition addresses, index order")
+    i.add_argument("--out", required=True)
+    i.set_defaults(fn=cmd_init)
+
+    s = sub.add_parser("show", help="print a validated partition map")
+    s.add_argument("--map", required=True)
+    s.set_defaults(fn=cmd_show)
+
+    r = sub.add_parser("route", help="resolve user ids to partitions")
+    r.add_argument("--map", required=True)
+    r.add_argument("user_ids", nargs="+")
+    r.set_defaults(fn=cmd_route)
+
+    sp = sub.add_parser(
+        "split",
+        help="move half the source partition's largest hash range onto a "
+             "new partition (crash-resumable; see docs/operations.md)",
+    )
+    sp.add_argument("--map", required=True)
+    sp.add_argument("--source", type=int, required=True,
+                    help="index of the partition to split")
+    sp.add_argument("--new-address", required=True,
+                    help="serving address of the new partition")
+    sp.add_argument("--source-state", required=True,
+                    help="the source partition's state_file")
+    sp.add_argument("--target-state", required=True,
+                    help="the new partition's state_file (created)")
+    sp.add_argument("--source-wal", default=None,
+                    help="default <source-state>.wal")
+    sp.add_argument("--target-wal", default=None,
+                    help="default <target-state>.wal")
+    sp.add_argument("--source-epoch", default=None,
+                    help="default <source-state>.epoch")
+    sp.add_argument("--target-epoch", default=None,
+                    help="default <target-state>.epoch")
+    sp.add_argument("--segment-bytes", type=int, default=65536)
+    sp.set_defaults(fn=cmd_split)
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    try:
+        return args.fn(args)
+    except (OSError, ValueError) as e:
+        print(f"fleet: {e}", file=sys.stderr)
+        return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -53,6 +53,7 @@ from ..server import metrics
 #: Endpoint catalog (the 404 body lists it; tests pin it).
 ENDPOINTS = (
     "/metrics", "/statusz", "/tracez", "/flightrec", "/healthz", "/slo",
+    "/partitionmap",
 )
 
 #: Schema tag of the ``/statusz`` payload.
@@ -78,6 +79,7 @@ class OpsSources:
     health: object | None = None       # HealthService
     service: object | None = None      # AuthServiceImpl (stream stats)
     slo: object | None = None          # SloEngine
+    fleet: object | None = None        # fleet.FleetRouter
     config_fingerprint: str = ""
     role: str = "server"               # "server" | "standby" | "audit"
     started_at: float = field(default_factory=time.monotonic)
@@ -181,6 +183,12 @@ class OpsSources:
 
         audit_log = self.audit_log
         doc["audit"] = audit_log.status() if audit_log is not None else None
+
+        # fleet partition rollup: this box's slot in the partition map,
+        # its owned keyspace share, and the wrong-partition redirects it
+        # has answered (map version/digest spot drift across the fleet)
+        fleet = self.fleet
+        doc["fleet"] = fleet.status() if fleet is not None else None
 
         durability = self.durability
         if durability is not None and getattr(durability, "wal", None) is not None:
@@ -386,6 +394,16 @@ class OpsPlane:
                         _json({"error": "no SLO engine attached"}))
             engine.tick()
             return 200, "application/json", _json(engine.snapshot())
+        if path == "/partitionmap":
+            fleet = self.sources.fleet
+            if fleet is None:
+                return (404, "application/json",
+                        _json({"error": "no partition map attached "
+                                        "([fleet] is disabled)"}))
+            # the canonical serialized map, digest included — exactly
+            # what PartitionMap.from_doc validates, so a client's
+            # map_refresh can point straight at this endpoint
+            return 200, "application/json", _json(fleet.map.to_doc())
         return (404, "application/json", _json({
             "error": f"unknown path {path!r}",
             "endpoints": list(ENDPOINTS),

@@ -120,6 +120,10 @@ class SloEngine:
         #: last computed per-RPC view (the ``/slo`` payload body)
         self._last: dict[str, dict] = {}
         self._pages = 0
+        #: fleet partition label ("" outside a fleet): stamped into the
+        #: ``/slo`` payload so per-partition dashboards can join burn
+        #: rates across the fleet without scraping instance labels
+        self.partition = ""
 
     # -- sampling ------------------------------------------------------------
 
@@ -278,6 +282,7 @@ class SloEngine:
         with self._lock:
             return {
                 "schema": SCHEMA,
+                "partition": self.partition,
                 "availability_target": self.settings.availability_target,
                 "fast_burn_threshold": self.settings.fast_burn_threshold,
                 "slow_burn_threshold": self.settings.slow_burn_threshold,

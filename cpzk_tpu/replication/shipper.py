@@ -53,9 +53,18 @@ class ReplicationTimeout(RuntimeError):
 
 
 class SegmentShipper:
-    """Ship sealed WAL segments + tail-follow deltas to the standby."""
+    """Ship sealed WAL segments + tail-follow deltas to the standby.
 
-    def __init__(self, state, manager, settings, faults=None):
+    With ``audit_log`` attached (a rotating
+    :class:`~cpzk_tpu.audit.ProofLogWriter`), sealed proof-log segments
+    ride the same loop as ``kind="audit"`` shipments: CRC-validated by
+    the standby and persisted as rotated-segment files next to *its*
+    proof log, so a machine death loses at most the unsealed audit tail
+    — the PR 9 trail survives hardware the way the WAL does.
+    """
+
+    def __init__(self, state, manager, settings, faults=None,
+                 audit_log=None):
         if manager is None or manager.wal is None:
             raise ValueError(
                 "SegmentShipper requires a recovered DurabilityManager"
@@ -64,6 +73,12 @@ class SegmentShipper:
         self.manager = manager
         self.settings = settings
         self._faults = faults
+        self.audit_log = audit_log  # ProofLogWriter | None
+        self.audit_segments_shipped = 0
+        #: sealed-segment basenames already accepted by the standby this
+        #: boot; a restart re-ships (the standby's atomic overwrite makes
+        #: duplicates idempotent)
+        self._audit_shipped: set[str] = set()
         self.pb2 = load_replication_pb2()
         self.epoch_path = settings.epoch_file or manager.state_file + ".epoch"
         self.epoch = load_epoch(self.epoch_path)
@@ -197,7 +212,9 @@ class SegmentShipper:
             new_bytes = sum(len(encode_record(r)) for r in new)
             self.acked_offset = offset + valid - new_bytes
         if not new:
-            await self._renew_lease()
+            await self._ship_audit_segments()
+            if not self.fenced:
+                await self._renew_lease()
             return
         for seg in split_records(
             new, self.epoch, self._index, self.settings.segment_bytes
@@ -205,6 +222,69 @@ class SegmentShipper:
             await self._ship(seg)
             if self.fenced:
                 return
+        await self._ship_audit_segments()
+
+    async def _ship_audit_segments(self) -> None:
+        """Ship sealed proof-log segments the standby has not accepted
+        yet (``kind="audit"``).  Sealed files are immutable, so the work
+        list is a directory scan and duplicates are idempotent on the
+        standby (atomic overwrite of an identical file)."""
+        log_writer = self.audit_log
+        if log_writer is None or self.fenced:
+            return
+        import os
+        import zlib
+
+        for path in log_writer.sealed_segments():
+            name = os.path.basename(path)
+            if name in self._audit_shipped:
+                continue
+
+            def _read_seg(p=path) -> bytes:
+                with open(p, "rb") as f:
+                    return f.read()
+
+            raw = await asyncio.to_thread(_read_seg)
+            records, valid = iter_frames(raw)
+            if valid != len(raw) or not records:
+                # a sealed segment is fsynced before the rename — this is
+                # disk corruption, not a race; skip it loudly rather than
+                # spinning on it every tick
+                log.error(
+                    "sealed proof-log segment %s does not parse cleanly; "
+                    "NOT shipped (inspect/restore from the primary copy)",
+                    path,
+                )
+                self._audit_shipped.add(name)
+                continue
+            stub = self._ensure_stub()
+            req = self.pb2.ShipSegmentRequest(
+                epoch=self.epoch,
+                segment_index=int(records[0]["seq"]),
+                first_seq=int(records[0]["seq"]),
+                last_seq=int(records[-1]["seq"]),
+                frames=raw,
+                crc32=zlib.crc32(raw) & 0xFFFFFFFF,
+                sealed=True,
+                primary_seq=self._wal_seq(),
+                sent_unix_ms=int(time.time() * 1000.0),
+                kind="audit",
+            )
+            resp = await stub.ship_segment(
+                req, timeout=self.settings.sync_timeout_ms / 1000.0
+            )
+            if resp.accepted:
+                self._audit_shipped.add(name)
+                self.audit_segments_shipped += 1
+                metrics.counter("audit.log.segments_shipped").inc()
+            elif resp.epoch > self.epoch or "fenced" in resp.message:
+                self._fence(resp.epoch, resp.message)
+                return
+            else:
+                log.warning(
+                    "audit segment %s rejected: %s", name, resp.message
+                )
+                return  # retry next tick
 
     def _wal_seq(self) -> int:
         wal = self.manager.wal
@@ -391,4 +471,5 @@ class SegmentShipper:
             ),
             "fenced": self.fenced,
             "gap_stalled": self.gap_stalled,
+            "audit_segments_shipped": self.audit_segments_shipped,
         }

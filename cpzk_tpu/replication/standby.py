@@ -173,7 +173,8 @@ class StandbyReplica:
     a promotion continues the same journal for its own writes.
     """
 
-    def __init__(self, state, manager, settings, faults=None, health=None):
+    def __init__(self, state, manager, settings, faults=None, health=None,
+                 audit_path: str | None = None):
         if manager is None or manager.wal is None:
             raise ValueError(
                 "StandbyReplica requires a recovered DurabilityManager "
@@ -184,6 +185,12 @@ class StandbyReplica:
         self.settings = settings
         self.health = health
         self._faults = faults
+        #: where shipped ``kind="audit"`` proof-log segments land (the
+        #: standby's own ``[audit] log_path``; segments are stored as
+        #: ``<audit_path>.<first>-<last>.seg`` exactly as the primary
+        #: sealed them, so a promotion continues the same directory)
+        self.audit_path = audit_path
+        self.audit_segments_received = 0
         self.pb2 = load_replication_pb2()
         self.role = "standby"
         self.epoch_path = settings.epoch_file or manager.state_file + ".epoch"
@@ -241,6 +248,7 @@ class StandbyReplica:
                 else round(time.monotonic() - self._last_segment_at, 3)
             ),
             "promotions": self._promotions,
+            "audit_segments_received": self.audit_segments_received,
         }
 
     # -- lease -------------------------------------------------------------
@@ -293,6 +301,8 @@ class StandbyReplica:
 
     async def ship_segment(self, request, context):
         del context
+        if getattr(request, "kind", "") == "audit":
+            return await self._ship_audit_segment(request)
         seg = Segment(
             epoch=request.epoch,
             index=request.segment_index,
@@ -355,6 +365,91 @@ class StandbyReplica:
         wal.append_frames(frames, last_seq)
         if wal.needs_sync():
             wal.sync()
+
+    async def _ship_audit_segment(self, request):
+        """A sealed proof-log segment (``kind="audit"``): validate CRC +
+        clean parse, persist it atomically as a rotated-segment file next
+        to this node's proof log.  Never replayed as state — proof
+        records are audit evidence, not mutations.  Same epoch fencing as
+        WAL segments; an identical re-delivery is an idempotent
+        overwrite."""
+        import zlib
+
+        from ..durability.wal import iter_frames as _iter
+
+        try:
+            epoch = int(request.epoch)
+        except (TypeError, ValueError):
+            epoch = -1
+        if self.role != "standby" or epoch < self.epoch:
+            self.applier.fenced += 1
+            metrics.counter("state.repl.fenced").inc()
+            return self.pb2.ShipSegmentResponse(
+                accepted=False, applied_seq=self.applied_seq,
+                epoch=self.epoch,
+                message=f"fenced: stale epoch {epoch} < {self.epoch}",
+            )
+        if self.audit_path is None:
+            return self.pb2.ShipSegmentResponse(
+                accepted=False, applied_seq=self.applied_seq,
+                epoch=self.epoch,
+                message="rejected: standby has no audit plane "
+                        "([audit] log_path unset)",
+            )
+        raw = bytes(request.frames)
+        if zlib.crc32(raw) & 0xFFFFFFFF != int(request.crc32) & 0xFFFFFFFF:
+            return self.pb2.ShipSegmentResponse(
+                accepted=False, applied_seq=self.applied_seq,
+                epoch=self.epoch, message="rejected: segment CRC mismatch",
+            )
+        records, valid = _iter(raw)
+        if valid != len(raw) or not records:
+            return self.pb2.ShipSegmentResponse(
+                accepted=False, applied_seq=self.applied_seq,
+                epoch=self.epoch,
+                message="rejected: segment frames do not parse cleanly",
+            )
+        if (
+            int(records[0]["seq"]) != int(request.first_seq)
+            or int(records[-1]["seq"]) != int(request.last_seq)
+        ):
+            return self.pb2.ShipSegmentResponse(
+                accepted=False, applied_seq=self.applied_seq,
+                epoch=self.epoch,
+                message="rejected: seq bounds do not match the frames",
+            )
+        from ..audit.log import segment_name
+
+        dst = segment_name(
+            self.audit_path, int(request.first_seq), int(request.last_seq)
+        )
+        await asyncio.to_thread(self._persist_audit_file, dst, raw)
+        self.audit_segments_received += 1
+        self._renew_lease()
+        return self.pb2.ShipSegmentResponse(
+            accepted=True, applied_seq=self.applied_seq, epoch=self.epoch,
+            message=f"audit segment stored ({len(records)} records)",
+        )
+
+    @staticmethod
+    def _persist_audit_file(dst: str, raw: bytes) -> None:
+        d = os.path.dirname(os.path.abspath(dst)) or "."
+        fd, tmp = tempfile.mkstemp(
+            prefix="." + os.path.basename(dst) + ".tmp.", dir=d
+        )
+        try:
+            with os.fdopen(fd, "wb") as f:
+                f.write(raw)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, dst)
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+        os.chmod(dst, 0o600)
 
     async def replication_status(self, request, context):
         del context

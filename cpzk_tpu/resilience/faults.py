@@ -44,7 +44,19 @@ from ..protocol.batch import VerifierBackend
 #: ``StandbyReplica`` the same way the WAL sites are by ``WriteAheadLog``.
 REPLICATION_CRASH_POINTS = ("pre_ship", "mid_segment", "pre_promote")
 
-ALL_CRASH_POINTS = WAL_CRASH_POINTS + REPLICATION_CRASH_POINTS
+#: Fleet-split crash sites (one per split stage — see
+#: ``cpzk_tpu/fleet/split.py`` SPLIT_CRASH_POINTS for the exact file
+#: state each leaves behind).  Consulted by ``run_split(..., faults=)``;
+#: the chaos suite SIGKILLs every stage through these and asserts both
+#: partitions come back with a disjoint, exhaustive key set.
+FLEET_CRASH_POINTS = (
+    "pre_manifest", "pre_copy", "mid_copy",
+    "pre_flip", "pre_drain", "pre_finish",
+)
+
+ALL_CRASH_POINTS = (
+    WAL_CRASH_POINTS + REPLICATION_CRASH_POINTS + FLEET_CRASH_POINTS
+)
 
 
 class InjectedFault(RuntimeError):

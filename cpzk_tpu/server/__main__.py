@@ -186,6 +186,8 @@ HELP = """Available commands:
   /audit       (/au)  proof-log status: path, bytes, seq, pending appends
   /replication (/repl) replication status: role, epoch, lag, lease
   /promote            promote this standby to primary (operator failover)
+  /fleet [reload] (/fl) partition-map status; `reload` re-reads the map
+                      file and adopts a strictly newer version (splits)
   /users       (/u)   registered user count
   /sessions    (/s)   active session count
   /challenges  (/c)   pending challenge count
@@ -197,7 +199,7 @@ HELP = """Available commands:
 
 async def handle_command(
     cmd: str, state: ServerState, backend=None, durability=None,
-    admission=None, replication=None, audit_log=None,
+    admission=None, replication=None, audit_log=None, fleet=None,
 ) -> tuple[str, bool]:
     """(output, should_quit) for one REPL line (server.rs:50-90,261-359).
     ``backend`` is the serving FailoverBackend (None on the inline CPU
@@ -208,7 +210,8 @@ async def handle_command(
     is the SegmentShipper (primary) or StandbyReplica (standby) behind
     /replication and /promote (None when replication is disabled);
     ``audit_log`` is the ProofLogWriter behind /audit (None when the
-    audit trail is disabled)."""
+    audit trail is disabled); ``fleet`` is the FleetRouter behind /fleet
+    (None when fleet routing is disabled)."""
     cmd = cmd.strip()
     if not cmd:
         return "", False
@@ -365,6 +368,34 @@ async def handle_command(
             f" records={s['records_applied']}"
             f" (skipped={s['records_skipped']})"
             f" lease={'unarmed' if lease is None else f'{lease:.2f}s'}",
+            False,
+        )
+    if word in ("/fleet", "/fl"):
+        if fleet is None:
+            return (
+                "fleet routing disabled (set [fleet] enabled = true with a "
+                "map_path to join an N-partition fleet)",
+                False,
+            )
+        parts = cmd.split()
+        if len(parts) > 1 and parts[1].lower() == "reload":
+            try:
+                changed = fleet.reload()
+            except (OSError, ValueError) as e:
+                return f"map reload failed: {e}", False
+            if not changed:
+                return (
+                    f"map unchanged (still v{fleet.map.version} "
+                    f"{fleet.map.short_digest()})",
+                    False,
+                )
+        s = fleet.status()
+        return (
+            f"partition={s['partition']}/{s['partitions']}"
+            f" map=v{s['map_version']} digest={s['map_digest']}"
+            f" address={s['address']}"
+            f" owned={s['owned_span_fraction']:.1%} of keyspace"
+            f" redirects={s['redirects']}",
             False,
         )
     if word == "/promote":
@@ -546,13 +577,33 @@ async def amain(args) -> None:
             config.admission.per_client_rpm, config.admission.max_clients,
         )
 
+    audit_log = None
+    if config.audit.enabled:
+        from ..audit import ProofLogWriter
+
+        audit_log = ProofLogWriter(
+            config.audit.log_path,
+            fsync=config.audit.fsync,
+            fsync_interval_ms=config.audit.fsync_interval_ms,
+            segment_bytes=config.audit.segment_bytes,
+        )
+        log.info(
+            "audit trail enabled: proof log at %s (fsync=%s, seq=%d, "
+            "segment_bytes=%d)",
+            config.audit.log_path, config.audit.fsync, audit_log.seq,
+            config.audit.segment_bytes,
+        )
+
     shipper = None
     replica = None
     if config.replication.enabled:
         from ..replication import SegmentShipper, StandbyReplica
 
         if config.replication.role == "standby":
-            replica = StandbyReplica(state, durability, config.replication)
+            replica = StandbyReplica(
+                state, durability, config.replication,
+                audit_path=config.audit.log_path or None,
+            )
             log.info(
                 "replication standby: epoch=%d applied_seq=%d (auth RPCs "
                 "refused until promotion; lease %gms, auto_promote=%s)",
@@ -560,7 +611,11 @@ async def amain(args) -> None:
                 config.replication.lease_ms, config.replication.auto_promote,
             )
         else:
-            shipper = SegmentShipper(state, durability, config.replication)
+            # sealed proof-log segments ride the same shipping loop as
+            # WAL segments, so the audit trail survives machine death too
+            shipper = SegmentShipper(
+                state, durability, config.replication, audit_log=audit_log
+            )
             durability.attach_shipper(shipper)
             if config.replication.mode == "sync":
                 state.attach_replication_barrier(shipper.wait_replicated)
@@ -572,18 +627,24 @@ async def amain(args) -> None:
                 config.replication.renew_interval_ms,
             )
 
-    audit_log = None
-    if config.audit.enabled:
-        from ..audit import ProofLogWriter
+    fleet_router = None
+    if config.fleet.enabled:
+        from ..fleet import FleetRouter, PartitionMap
 
-        audit_log = ProofLogWriter(
-            config.audit.log_path,
-            fsync=config.audit.fsync,
-            fsync_interval_ms=config.audit.fsync_interval_ms,
+        pmap = PartitionMap.load(config.fleet.map_path)
+        idx = config.fleet.partition
+        if idx < 0:
+            advertise = config.fleet.advertise or config.addr()
+            idx = pmap.index_of_address(advertise)
+        fleet_router = FleetRouter(
+            pmap, idx, map_path=config.fleet.map_path
         )
+        me = pmap.partitions[idx]
         log.info(
-            "audit trail enabled: proof log at %s (fsync=%s, seq=%d)",
-            config.audit.log_path, config.audit.fsync, audit_log.seq,
+            "fleet routing enabled: partition %d/%d (map v%d %s, owns "
+            "%.1f%% of the keyspace as %s)",
+            idx, len(pmap.partitions), pmap.version, pmap.short_digest(),
+            100.0 * me.span() / (1 << 32), me.address,
         )
 
     # started after the replication block: an unpromoted standby's sweep
@@ -617,6 +678,11 @@ async def amain(args) -> None:
 
     slo_task = asyncio.create_task(slo_ticker())
 
+    if fleet_router is not None:
+        # per-partition SLO attribution: the /slo payload (and /statusz
+        # rollup) names this partition so fleet dashboards can join
+        slo_engine.partition = str(fleet_router.self_index)
+
     ops_sources = OpsSources(
         state=state,
         batcher=batcher,
@@ -626,6 +692,7 @@ async def amain(args) -> None:
         audit_log=audit_log,
         durability=durability,
         slo=slo_engine,
+        fleet=fleet_router,
         config_fingerprint=config.fingerprint(),
         role="standby" if replica is not None else "server",
     )
@@ -652,6 +719,7 @@ async def amain(args) -> None:
         replica=replica, audit_log=audit_log,
         stream_window=config.tpu.stream_window,
         stream_entry_deadline_ms=config.tpu.stream_entry_deadline_ms,
+        fleet=fleet_router,
     )
     # late attachments: serve() built these (health gate, stream registry)
     ops_sources.health = server.health
@@ -694,7 +762,7 @@ async def amain(args) -> None:
                 return
             out, quit_ = await handle_command(
                 line, state, backend, durability, admission,
-                shipper or replica, audit_log,
+                shipper or replica, audit_log, fleet_router,
             )
             if out:
                 print(_c("white", out))

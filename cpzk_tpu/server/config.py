@@ -230,6 +230,31 @@ class AuditSettings:
                                   # audit trail usually tolerates losing
                                   # the last instants of a crash
     fsync_interval_ms: float = 200.0  # cadence under the interval policy
+    segment_bytes: int = 0        # rotate the log into sealed
+                                  # <log>.<first>-<last>.seg files at about
+                                  # this size; sealed segments ship to the
+                                  # replication standby so a machine death
+                                  # loses at most the unsealed tail
+                                  # (0 = never rotate)
+
+
+@dataclass
+class FleetSettings:
+    """N-partition fleet routing (fleet subsystem): this daemon's slot in
+    a versioned :class:`~cpzk_tpu.fleet.PartitionMap`.  Every auth RPC
+    then checks ownership before touching state and redirects
+    wrong-partition requests with the map version + owner address in
+    trailing metadata; the ops plane serves the map read-only at
+    ``/partitionmap``.  See ``docs/operations.md`` §"Partitioned
+    fleet"."""
+
+    enabled: bool = False      # opt-in; requires map_path
+    map_path: str = ""         # the serialized partition-map JSON file
+    partition: int = -1        # this daemon's partition index;
+                               # -1 = discover by matching `advertise`
+                               # (or host:port) against the map
+    advertise: str = ""        # this partition's address as it appears in
+                               # the map (empty = "<host>:<port>")
 
 
 @dataclass
@@ -308,6 +333,7 @@ class ServerConfig:
     audit: AuditSettings = field(default_factory=AuditSettings)
     opsplane: OpsplaneSettings = field(default_factory=OpsplaneSettings)
     slo: SloSettings = field(default_factory=SloSettings)
+    fleet: FleetSettings = field(default_factory=FleetSettings)
 
     def addr(self) -> str:
         return f"{self.host}:{self.port}"
@@ -357,6 +383,7 @@ class ServerConfig:
             ("audit", self.audit),
             ("opsplane", self.opsplane),
             ("slo", self.slo),
+            ("fleet", self.fleet),
         ):
             for key, value in data.get(section, {}).items():
                 if hasattr(obj, key):
@@ -538,6 +565,17 @@ class ServerConfig:
             self.audit.fsync = v.lower()
         if (v := get("AUDIT_FSYNC_INTERVAL_MS")) is not None:
             self.audit.fsync_interval_ms = float(v)
+        if (v := get("AUDIT_SEGMENT_BYTES")) is not None:
+            self.audit.segment_bytes = int(v)
+        # fleet knobs (partition-map routing)
+        if (v := get("FLEET_ENABLED")) is not None:
+            self.fleet.enabled = v.lower() in ("1", "true", "yes", "on")
+        if (v := get("FLEET_MAP_PATH")) is not None:
+            self.fleet.map_path = v
+        if (v := get("FLEET_PARTITION")) is not None:
+            self.fleet.partition = int(v)
+        if (v := get("FLEET_ADVERTISE")) is not None:
+            self.fleet.advertise = v
 
     # --- validation (config.rs:238-273) ---
 
@@ -734,6 +772,21 @@ class ServerConfig:
             raise ValueError(
                 "audit.enabled requires log_path (where the proof log "
                 "is appended)"
+            )
+        if self.audit.segment_bytes < 0:
+            raise ValueError(
+                "audit.segment_bytes cannot be negative (0 disables "
+                "proof-log rotation)"
+            )
+        if self.fleet.enabled and not self.fleet.map_path:
+            raise ValueError(
+                "fleet.enabled requires map_path (the partition-map JSON "
+                "every daemon in the fleet shares)"
+            )
+        if self.fleet.partition < -1:
+            raise ValueError(
+                "fleet.partition must be a partition index, or -1 to "
+                "discover it from the advertise address"
             )
         try:
             buckets = self.observability.parsed_buckets()

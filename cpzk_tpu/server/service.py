@@ -20,6 +20,7 @@ from dataclasses import dataclass, field
 
 from .. import errors
 from ..admission import RETRY_PUSHBACK_KEY, client_key
+from ..fleet.partition_map import PARTITION_MAP_VERSION_KEY, PARTITION_OWNER_KEY
 from ..audit.log import proof_record
 from ..core.ristretto import Ristretto255
 from ..core.rng import SecureRng
@@ -68,6 +69,7 @@ class AuthServiceImpl:
         audit_log=None,
         stream_window: int = 8192,
         stream_entry_deadline_ms: float = 0.0,
+        fleet=None,
     ):
         self.state = state
         self.rate_limiter = rate_limiter
@@ -76,6 +78,7 @@ class AuthServiceImpl:
         self.admission = admission  # AdmissionController | None
         self.replica = replica  # StandbyReplica | None (replication standby)
         self.audit_log = audit_log  # audit.ProofLogWriter | None (opt-in)
+        self.fleet = fleet  # fleet.FleetRouter | None (partition ownership)
         #: max proof entries in flight per VerifyProofStream before the
         #: reader stops pulling (gRPC flow control then pushes back on the
         #: sender) — bounds per-stream memory without killing the stream
@@ -162,6 +165,9 @@ class AuthServiceImpl:
         refuses every auth RPC outright — its state is a replica of the
         primary's, and writes on it would fork history."""
         if self.replica is not None and self.replica.role != "primary":
+            # counted like every other shed path so the /slo burn math and
+            # dashboards see standby refusals, not a silent abort
+            metrics.counter("admission.shed.standby").inc()
             await context.abort(
                 grpc.StatusCode.UNAVAILABLE,
                 "standby replica: not promoted (writes go to the primary)",
@@ -187,6 +193,59 @@ class AuthServiceImpl:
         msg = _user_id_error(user_id)
         if msg is not None:
             await context.abort(grpc.StatusCode.INVALID_ARGUMENT, msg)
+
+    def _wrong_partition(self, user_id: str) -> str | None:
+        """Redirect message when this partition does not own ``user_id``
+        under the loaded map, else ``None``.  The single-partition fast
+        path is a constant-time no-op inside ``FleetRouter.owns`` — fleet
+        routing must cost the N=1 hot path nothing (perf-gate pinned)."""
+        fleet = self.fleet
+        if fleet is None or fleet.owns(user_id):
+            return None
+        owner = fleet.owner(user_id)
+        return (
+            f"wrong partition: user is owned by partition {owner.index} "
+            f"at {owner.address} (map v{fleet.map.version})"
+        )
+
+    def _wrong_partition_counted(self, user_id: str) -> str | None:
+        """Per-entry form for the batch/stream paths: the same redirect
+        message as :meth:`_check_owner`, counted, but answered as an
+        individual failure (one misrouted entry must not abort its batch
+        siblings — the client fans batches out per partition)."""
+        msg = self._wrong_partition(user_id)
+        if msg is not None:
+            self.fleet.redirects += 1
+            metrics.counter("fleet.redirects").inc()
+        return msg
+
+    async def _check_owner(self, user_id: str, context) -> None:
+        """Partition-ownership enforcement, BEFORE any state access: a
+        wrong-partition request aborts ``FAILED_PRECONDITION`` with the
+        map version and the owning partition's address in trailing
+        metadata (the same trailer discipline as retry pushback), so a
+        stale-map client can refresh + re-route in one round trip.
+        Running this ahead of every state touch is what makes the
+        redirect replay-safe even for ``VerifyProof`` — the challenge is
+        still unconsumed when the redirect goes out."""
+        msg = self._wrong_partition(user_id)
+        if msg is None:
+            return
+        fleet = self.fleet
+        fleet.redirects += 1
+        metrics.counter("fleet.redirects").inc()
+        owner = fleet.owner(user_id)
+        md = (
+            (PARTITION_MAP_VERSION_KEY, str(fleet.map.version)),
+            (PARTITION_OWNER_KEY, owner.address),
+        )
+        try:
+            await context.abort(
+                grpc.StatusCode.FAILED_PRECONDITION, msg,
+                trailing_metadata=md,
+            )
+        except TypeError:  # hand-rolled test context without the kwarg
+            await context.abort(grpc.StatusCode.FAILED_PRECONDITION, msg)
 
     @staticmethod
     def _request_context(context):
@@ -262,6 +321,7 @@ class AuthServiceImpl:
     async def register(self, request, context):
         await self._admit(context, "Register")
         await self._validate_user_id(request.user_id, context)
+        await self._check_owner(request.user_id, context)
 
         if not request.y1 or not request.y2:
             await context.abort(grpc.StatusCode.INVALID_ARGUMENT, "Empty y1 or y2 values")
@@ -314,6 +374,8 @@ class AuthServiceImpl:
 
             msg = _user_id_error(user_id)
             if msg is None:
+                msg = self._wrong_partition_counted(user_id)
+            if msg is None:
                 if not y1b or not y2b:
                     msg = f"Empty y1 or y2 values for user {i}"
                 elif len(y1b) > MAX_ELEMENT_WIRE or len(y2b) > MAX_ELEMENT_WIRE:
@@ -354,6 +416,7 @@ class AuthServiceImpl:
     async def create_challenge(self, request, context):
         await self._admit(context, "CreateChallenge")
         await self._validate_user_id(request.user_id, context)
+        await self._check_owner(request.user_id, context)
 
         user = await self.state.get_user(request.user_id)
         if user is None:
@@ -381,6 +444,9 @@ class AuthServiceImpl:
     async def verify_proof(self, request, context):
         await self._admit(context, "VerifyProof")
         await self._validate_user_id(request.user_id, context)
+        # ownership BEFORE consume_challenge: a redirected VerifyProof
+        # never burned its challenge, so re-routing it is safe
+        await self._check_owner(request.user_id, context)
 
         msg = _proof_args_error(request.challenge_id, request.proof)
         if msg is not None:
@@ -490,6 +556,11 @@ class AuthServiceImpl:
         staged: list[int] = []  # indices that passed arg validation
         for i in range(n):
             msg = _user_id_error(user_ids[i])
+            if msg is None:
+                # ownership BEFORE staging: a misrouted entry is answered
+                # with the redirect message and its challenge is NEVER
+                # consumed, so re-sending it to the owner succeeds
+                msg = self._wrong_partition_counted(user_ids[i])
             if msg is None:
                 msg = _proof_args_error(challenge_ids[i], proof_wires[i], index=i)
             contexts.append(None)
@@ -673,6 +744,7 @@ class AuthServiceImpl:
         - **verdict order** follows entry order.
         """
         if self.replica is not None and self.replica.role != "primary":
+            metrics.counter("admission.shed.standby").inc()
             await context.abort(
                 grpc.StatusCode.UNAVAILABLE,
                 "standby replica: not promoted (writes go to the primary)",
@@ -819,7 +891,12 @@ class AuthServiceImpl:
             if uid in uid_memo:
                 msg = uid_memo[uid]
             else:
-                msg = uid_memo[uid] = _user_id_error(uid)
+                # ownership rides the same memo as user-id validation
+                # (streams repeat user ids): one hash per distinct user,
+                # misrouted entries answered per-entry, stream survives
+                msg = uid_memo[uid] = (
+                    _user_id_error(uid) or self._wrong_partition_counted(uid)
+                )
             msg = msg or _proof_args_error(challenge_ids[i], proof_wires[i])
             if msg is not None:
                 work.messages[i] = msg
@@ -1058,6 +1135,7 @@ async def serve(
     audit_log=None,
     stream_window: int = 8192,
     stream_entry_deadline_ms: float = 0.0,
+    fleet=None,
 ):
     """Build and start an aio server; returns (server, bound_port).
 
@@ -1078,7 +1156,11 @@ async def serve(
     (statement, challenge, proof, verdict) records to — the bulk audit
     pipeline's input; the daemon closes it after the batcher drains.
     ``stream_window`` / ``stream_entry_deadline_ms`` are the
-    VerifyProofStream flow-control knobs (``[tpu]`` config).
+    VerifyProofStream flow-control knobs (``[tpu]`` config).  ``fleet``
+    is an optional :class:`~cpzk_tpu.fleet.FleetRouter`: every auth RPC
+    then checks partition ownership before touching state and redirects
+    wrong-partition requests with the map version + owner address in
+    trailing metadata (docs/operations.md §"Partitioned fleet").
     """
     server = grpc.aio.server()
     service = AuthServiceImpl(
@@ -1086,6 +1168,7 @@ async def serve(
         admission=admission, replica=replica, audit_log=audit_log,
         stream_window=stream_window,
         stream_entry_deadline_ms=stream_entry_deadline_ms,
+        fleet=fleet,
     )
     server.add_generic_rpc_handlers((make_generic_handler(service),))
     if replica is not None:
@@ -1100,6 +1183,7 @@ async def serve(
     server.admission = admission
     server.replica = replica
     server.audit_log = audit_log  # daemon closes it after the batcher drains
+    server.fleet = fleet  # ops plane: /partitionmap + /statusz fleet block
     if batcher is not None:
         batcher.start()
     addr = f"{host}:{port}"

@@ -1,0 +1,1258 @@
+"""N-partition fleet (ROADMAP item 3): versioned partition map,
+wrong-partition redirect, live splitting over the WAL replication plane.
+
+Covers the map contract (routing totality, disjoint+exhaustive
+validation, versioned split, digest), the server-side ownership
+enforcement + redirect trailers (incl. the N=1 fast path the perf gate
+leans on), the client-side channel pool / redirect / batch fan-out, the
+crash-resumable split flow at every FaultPlan stage, the rotated
+proof-log + shipping tail (PR 9), the ``[fleet]`` config surface, and
+the 3-partition chaos acceptance: SIGKILL one partition's primary — that
+partition auto-promotes while the other two serve uninterrupted, and a
+stale-map client converges in one redirect.
+"""
+
+import asyncio
+import dataclasses
+import json
+import os
+import pathlib
+import re
+
+import grpc
+import pytest
+
+from cpzk_tpu import Parameters, Prover, SecureRng, Witness
+from cpzk_tpu.client.rpc import AuthClient
+from cpzk_tpu.core.ristretto import Ristretto255
+from cpzk_tpu.durability import DurabilityManager
+from cpzk_tpu.fleet import (
+    HASH_SPACE,
+    FleetRouter,
+    PartitionMap,
+    run_split,
+    user_hash,
+)
+from cpzk_tpu.fleet.split import SPLIT_CRASH_POINTS, SplitError, manifest_path
+from cpzk_tpu.replication import SegmentShipper, StandbyReplica
+from cpzk_tpu.resilience.faults import CrashPoint, FaultPlan
+from cpzk_tpu.server import metrics
+from cpzk_tpu.server.config import (
+    DurabilitySettings,
+    FleetSettings,
+    RateLimiter,
+    ReplicationSettings,
+    ServerConfig,
+)
+from cpzk_tpu.server.service import serve
+from cpzk_tpu.server.state import ServerState, UserData
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+
+rng = SecureRng()
+params = Parameters.new()
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def make_statement():
+    return Prover(params, Witness(Ristretto255.random_scalar(rng))).statement
+
+
+def uid_on_partition(pmap: PartitionMap, index: int, tag: str = "u") -> str:
+    """A user id the map routes to partition ``index``."""
+    i = 0
+    while True:
+        uid = f"{tag}{i}"
+        if pmap.partition_for(uid).index == index:
+            return uid
+        i += 1
+
+
+async def wait_for(predicate, timeout=8.0, interval=0.02):
+    deadline = asyncio.get_running_loop().time() + timeout
+    while not predicate():
+        if asyncio.get_running_loop().time() > deadline:
+            raise AssertionError("condition not reached in time")
+        await asyncio.sleep(interval)
+
+
+# --- the partition map ------------------------------------------------------
+
+
+class TestPartitionMap:
+    def test_uniform_routing_is_total_and_stable(self):
+        pmap = PartitionMap.uniform(["a:1", "b:2", "c:3"])
+        assert pmap.version == 1
+        # totality over arbitrary ids: every id lands on exactly one
+        # partition, and that partition's ranges cover its hash
+        for uid in ["alice", "", "猫" * 40, "x" * 300, "u-1.2_3", "\x00"]:
+            p = pmap.partition_for(uid)
+            assert p.covers(user_hash(uid))
+            assert sum(
+                q.covers(user_hash(uid)) for q in pmap.partitions
+            ) == 1
+        # placement is the stable crc32 the state shards use
+        assert user_hash("alice") == __import__("zlib").crc32(b"alice")
+
+    def test_ranges_are_disjoint_and_exhaustive(self):
+        pmap = PartitionMap.uniform([f"h:{i}" for i in range(7)])
+        spans = sorted(
+            (lo, hi) for p in pmap.partitions for lo, hi in p.ranges
+        )
+        cursor = 0
+        for lo, hi in spans:
+            assert lo == cursor
+            cursor = hi
+        assert cursor == HASH_SPACE
+
+    @pytest.mark.parametrize("mutate,match", [
+        (lambda d: d.update(schema="nope"), "schema"),
+        (lambda d: d.update(version=0), "version"),
+        (lambda d: d["partitions"][0].update(address=""), "address"),
+        (lambda d: d["partitions"][0]["ranges"][0].__setitem__(1, 99),
+         "overlap|gap"),
+        (lambda d: d["partitions"].pop(), "gap|indexes"),
+        (lambda d: d["partitions"][0].update(index=5), "indexes"),
+        (lambda d: d.update(digest="0" * 64), "digest"),
+        (lambda d: d.update(partitions="zzz"), "list"),
+    ])
+    def test_from_doc_rejects_malformed(self, mutate, match):
+        doc = PartitionMap.uniform(["a:1", "b:2"]).to_doc()
+        had_digest = doc.pop("digest")
+        mutate(doc)
+        if "digest" not in doc:
+            doc.pop("digest", None)
+        with pytest.raises(ValueError, match=match):
+            PartitionMap.from_doc(doc)
+        # untouched doc (with its real digest) still parses
+        good = PartitionMap.uniform(["a:1", "b:2"]).to_doc()
+        assert PartitionMap.from_doc(good).digest == had_digest
+
+    def test_split_bumps_version_moves_upper_half(self):
+        pmap = PartitionMap.uniform(["a:1", "b:2", "c:3"])
+        new_map, moved = pmap.split(1, "d:4")
+        assert new_map.version == pmap.version + 1
+        assert len(new_map.partitions) == 4
+        assert new_map.partitions[3].address == "d:4"
+        assert new_map.partitions[3].ranges == moved
+        # non-moved users keep their owner; moved users go 1 -> 3
+        for i in range(500):
+            uid = f"u{i}"
+            before = pmap.partition_for(uid).index
+            after = new_map.partition_for(uid).index
+            if before != after:
+                assert (before, after) == (1, 3)
+                assert any(
+                    lo <= user_hash(uid) < hi for lo, hi in moved
+                )
+
+    def test_store_load_roundtrip_and_digest(self, tmp_path):
+        pmap, _ = PartitionMap.uniform(["a:1", "b:2"]).split(0, "c:3")
+        path = str(tmp_path / "map.json")
+        pmap.store(path)
+        loaded = PartitionMap.load(path)
+        assert loaded.version == pmap.version == 2
+        assert loaded.digest == pmap.digest
+        assert loaded.to_json() == pmap.to_json()
+        assert loaded.index_of_address("c:3") == 2
+        with pytest.raises(ValueError, match="not in the partition map"):
+            loaded.index_of_address("nope:9")
+
+    def test_router_n1_fast_path_never_hashes(self, monkeypatch):
+        """A single-partition map must short-circuit before any hash —
+        the structural guarantee behind the perf-gate acceptance."""
+        router = FleetRouter(PartitionMap.uniform(["only:1"]), 0)
+
+        def boom(_uid):  # pragma: no cover - the point is it never runs
+            raise AssertionError("N=1 owns() computed a hash")
+
+        monkeypatch.setattr(
+            "cpzk_tpu.fleet.partition_map.user_hash", boom
+        )
+        assert router.owns("anything") is True
+        assert router.owns("") is True
+
+    def test_router_reload_adopts_strictly_newer(self, tmp_path):
+        path = str(tmp_path / "map.json")
+        v1 = PartitionMap.uniform(["a:1", "b:2"])
+        v1.store(path)
+        router = FleetRouter(v1, 0, map_path=path)
+        assert router.reload() is False  # same version: no-op
+        v2, _ = v1.split(1, "c:3")
+        v2.store(path)
+        assert router.reload() is True
+        assert router.map.version == 2
+        assert router.status()["map_version"] == 2
+
+
+# --- server-side enforcement over real gRPC ---------------------------------
+
+
+async def _two_partition_fleet():
+    """Two plain servers + a v1 map over their real ports; routers
+    installed on both.  Returns (pmap, states, servers, ports)."""
+    states = [ServerState(), ServerState()]
+    srv0, p0 = await serve(states[0], RateLimiter(10**6, 10**6), port=0)
+    srv1, p1 = await serve(states[1], RateLimiter(10**6, 10**6), port=0)
+    pmap = PartitionMap.uniform([f"127.0.0.1:{p0}", f"127.0.0.1:{p1}"])
+    srv0.auth_service.fleet = FleetRouter(pmap, 0)
+    srv1.auth_service.fleet = FleetRouter(pmap, 1)
+    return pmap, states, (srv0, srv1), (p0, p1)
+
+
+class TestEnforcement:
+    def test_wrong_partition_redirect_trailers(self):
+        from cpzk_tpu.client.__main__ import do_login, do_register
+
+        async def main():
+            pmap, states, servers, ports = await _two_partition_fleet()
+            u1 = uid_on_partition(pmap, 1)
+            before = metrics.read("fleet.redirects")
+            try:
+                # correct routing serves normally end to end
+                c = AuthClient(partition_map=pmap)
+                assert "Registered" in await do_register(c, u1, "pw")
+                assert "Login OK" in await do_login(c, u1, "pw")
+                assert u1 in states[1]._users and u1 not in states[0]._users
+                assert c.redirects == 0
+                await c.close()
+
+                # a mapless client hitting the wrong box gets the full
+                # redirect contract: FAILED_PRECONDITION + both trailers
+                c2 = AuthClient(f"127.0.0.1:{ports[0]}")
+                with pytest.raises(grpc.aio.AioRpcError) as exc:
+                    await c2.create_challenge(u1)
+                assert exc.value.code() == grpc.StatusCode.FAILED_PRECONDITION
+                tmd = {
+                    k: v for k, v in exc.value.trailing_metadata() or ()
+                }
+                assert tmd["cpzk-partition-map-version"] == "1"
+                assert tmd["cpzk-partition-owner"] == f"127.0.0.1:{ports[1]}"
+                assert "partition 1" in exc.value.details()
+                await c2.close()
+                assert metrics.read("fleet.redirects") >= before + 1
+                assert servers[0].auth_service.fleet.redirects >= 1
+            finally:
+                for s in servers:
+                    await s.stop(None)
+
+        run(main())
+
+    def test_verify_proof_redirect_never_consumes_challenge(self):
+        """The redirect fires BEFORE consume_challenge: the same proof
+        re-sent to the owner must still authenticate."""
+        from cpzk_tpu.client.kdf import password_to_scalar
+        from cpzk_tpu.core.transcript import Transcript
+
+        async def main():
+            pmap, states, servers, ports = await _two_partition_fleet()
+            u1 = uid_on_partition(pmap, 1)
+            try:
+                prover = Prover(params, Witness(password_to_scalar("pw", u1)))
+                eb = Ristretto255.element_to_bytes
+                owner = AuthClient(f"127.0.0.1:{ports[1]}")
+                await owner.register(
+                    u1, eb(prover.statement.y1), eb(prover.statement.y2)
+                )
+                ch = await owner.create_challenge(u1)
+                cid = bytes(ch.challenge_id)
+                t = Transcript()
+                t.append_context(cid)
+                wire = prover.prove_with_transcript(SecureRng(), t).to_bytes()
+
+                wrong = AuthClient(f"127.0.0.1:{ports[0]}")
+                with pytest.raises(grpc.aio.AioRpcError) as exc:
+                    await wrong.verify_proof(u1, cid, wire)
+                assert exc.value.code() == grpc.StatusCode.FAILED_PRECONDITION
+                await wrong.close()
+                # the challenge survived the redirect — the owner accepts
+                resp = await owner.verify_proof(u1, cid, wire)
+                assert resp.success
+                await owner.close()
+            finally:
+                for s in servers:
+                    await s.stop(None)
+
+        run(main())
+
+    def test_batch_and_stream_answer_misrouted_entries_individually(self):
+        from cpzk_tpu.client.kdf import password_to_scalar
+        from cpzk_tpu.core.transcript import Transcript
+
+        async def main():
+            pmap, states, servers, ports = await _two_partition_fleet()
+            u0 = uid_on_partition(pmap, 0)
+            u1 = uid_on_partition(pmap, 1)
+            try:
+                eb = Ristretto255.element_to_bytes
+                pr0 = Prover(params, Witness(password_to_scalar("p0", u0)))
+                pr1 = Prover(params, Witness(password_to_scalar("p1", u1)))
+                c0 = AuthClient(f"127.0.0.1:{ports[0]}")
+                # mixed batch at partition 0: u0 lands, u1 redirects
+                resp = await c0.register_batch(
+                    [u0, u1],
+                    [eb(pr0.statement.y1), eb(pr1.statement.y1)],
+                    [eb(pr0.statement.y2), eb(pr1.statement.y2)],
+                )
+                assert resp.results[0].success
+                assert not resp.results[1].success
+                assert "wrong partition" in resp.results[1].message
+                assert u1 not in states[0]._users
+
+                # stream: the misrouted entry gets a per-entry failure,
+                # the owned entry verifies, the stream survives
+                ch = await c0.create_challenge(u0)
+                cid = bytes(ch.challenge_id)
+                t = Transcript()
+                t.append_context(cid)
+                wire = pr0.prove_with_transcript(SecureRng(), t).to_bytes()
+                verdicts = []
+                async for v in c0.verify_proof_stream(
+                    [(u0, cid, wire), (u1, b"\x01" * 32, wire)]
+                ):
+                    verdicts.append(v)
+                assert len(verdicts) == 2
+                assert verdicts[0].ok
+                assert not verdicts[1].ok
+                assert "wrong partition" in verdicts[1].message
+                await c0.close()
+            finally:
+                for s in servers:
+                    await s.stop(None)
+
+        run(main())
+
+    def test_standby_refusal_counts_admission_shed(self, tmp_path):
+        """Satellite fix: the standby's UNAVAILABLE abort (and the
+        redirect abort) are charged to counters the SLO burn math can
+        see, not silently dropped."""
+
+        async def main():
+            sstate = ServerState()
+            smgr = DurabilityManager(
+                sstate, DurabilitySettings(enabled=True),
+                str(tmp_path / "s.json"),
+            )
+            await smgr.recover()
+            replica = StandbyReplica(
+                sstate, smgr,
+                ReplicationSettings(
+                    enabled=True, role="standby", lease_ms=5000,
+                    renew_interval_ms=100,
+                ),
+            )
+            sserver, sport = await serve(
+                sstate, RateLimiter(10**6, 10**6), port=0, replica=replica
+            )
+            before = metrics.read("admission.shed.standby")
+            try:
+                async with AuthClient(f"127.0.0.1:{sport}") as c:
+                    with pytest.raises(grpc.aio.AioRpcError) as exc:
+                        await c.create_challenge("alice")
+                    assert exc.value.code() == grpc.StatusCode.UNAVAILABLE
+                assert metrics.read("admission.shed.standby") == before + 1
+            finally:
+                await replica.stop()
+                await sserver.stop(None)
+
+        run(main())
+
+
+# --- client-side routing ----------------------------------------------------
+
+
+class TestClientRouting:
+    def test_batch_fanout_preserves_entry_order(self):
+        from cpzk_tpu.client.kdf import password_to_scalar
+        from cpzk_tpu.core.transcript import Transcript
+
+        async def main():
+            pmap, states, servers, ports = await _two_partition_fleet()
+            try:
+                users = [f"bu{i}" for i in range(8)]
+                provers = {
+                    u: Prover(params, Witness(password_to_scalar("pw", u)))
+                    for u in users
+                }
+                eb = Ristretto255.element_to_bytes
+                c = AuthClient(partition_map=pmap)
+                resp = await c.register_batch(
+                    users,
+                    [eb(provers[u].statement.y1) for u in users],
+                    [eb(provers[u].statement.y2) for u in users],
+                )
+                assert len(resp.results) == len(users)
+                assert all(r.success for r in resp.results), [
+                    r.message for r in resp.results
+                ]
+                # each user landed on its owning partition, none on both
+                for u in users:
+                    idx = pmap.partition_for(u).index
+                    assert u in states[idx]._users
+                    assert u not in states[1 - idx]._users
+
+                # full verify_proof_batch fan-out, results in order
+                cids, wires = [], []
+                for u in users:
+                    ch = await c.create_challenge(u)
+                    cid = bytes(ch.challenge_id)
+                    t = Transcript()
+                    t.append_context(cid)
+                    cids.append(cid)
+                    wires.append(provers[u].prove_with_transcript(
+                        SecureRng(), t).to_bytes())
+                vresp = await c.verify_proof_batch(users, cids, wires)
+                assert all(r.success for r in vresp.results), [
+                    r.message for r in vresp.results
+                ]
+                assert [r.session_token[:0] for r in vresp.results] == [""] * 8
+                await c.close()
+            finally:
+                for s in servers:
+                    await s.stop(None)
+
+        run(main())
+
+    def test_stale_map_client_converges_in_one_redirect(self):
+        from cpzk_tpu.client.__main__ import do_login, do_register
+
+        async def main():
+            pmap, states, servers, ports = await _two_partition_fleet()
+            u1 = uid_on_partition(pmap, 1)
+            refreshes = []
+            try:
+                c = AuthClient(partition_map=pmap)
+                assert "Registered" in await do_register(c, u1, "pw")
+                await c.close()
+
+                # stale view: one partition, everything at server 0
+                stale = PartitionMap.uniform([f"127.0.0.1:{ports[0]}"])
+
+                def refresh():
+                    refreshes.append(1)
+                    return PartitionMap.from_doc(pmap.to_doc())
+
+                c2 = AuthClient(partition_map=stale, map_refresh=refresh)
+                out = await do_login(c2, u1, "pw")
+                assert "Login OK" in out, out
+                # one redirect per RPC attempt (challenge + verify), each
+                # converging in exactly one re-route
+                assert c2.redirects <= 2
+                assert refreshes  # the bounded refresh actually ran
+                await c2.close()
+            finally:
+                for s in servers:
+                    await s.stop(None)
+
+        run(main())
+
+    def test_redirect_charges_the_retry_budget(self):
+        from cpzk_tpu.resilience.retry import RetryBudget, RetryPolicy
+
+        async def main():
+            pmap, states, servers, ports = await _two_partition_fleet()
+            u1 = uid_on_partition(pmap, 1)
+            try:
+                stale = PartitionMap.uniform([f"127.0.0.1:{ports[0]}"])
+                policy = RetryPolicy(budget=RetryBudget(tokens=10.0))
+                c = AuthClient(partition_map=stale, retry=policy)
+                before = policy.budget.tokens
+                with pytest.raises(grpc.aio.AioRpcError):
+                    # registration of an unowned user redirects (budget
+                    # charged), then the owner rejects the junk wire
+                    await c.register(u1, b"\x00", b"\x00")
+                assert policy.budget.tokens < before
+                assert c.redirects == 1
+                await c.close()
+
+                # an exhausted budget refuses the re-route outright
+                drained = RetryPolicy(budget=RetryBudget(tokens=1.0))
+                drained.budget._tokens = 0.5
+                c2 = AuthClient(partition_map=stale, retry=drained)
+                with pytest.raises(grpc.aio.AioRpcError) as exc:
+                    await c2.create_challenge(u1)
+                assert exc.value.code() == grpc.StatusCode.FAILED_PRECONDITION
+                assert c2.redirects == 0
+                await c2.close()
+            finally:
+                for s in servers:
+                    await s.stop(None)
+
+        run(main())
+
+    def test_plain_failed_precondition_is_not_a_redirect(self):
+        """Only the fleet's own trailer pair triggers a re-route: a bare
+        FAILED_PRECONDITION (or one with half the trailers) parses as
+        not-a-redirect and surfaces immediately."""
+        from cpzk_tpu.client.rpc import _redirect_info
+
+        class FakeErr:
+            def __init__(self, md):
+                self._md = md
+
+            def trailing_metadata(self):
+                return self._md
+
+        assert _redirect_info(FakeErr(())) == (None, None)
+        assert _redirect_info(FakeErr((
+            ("cpzk-partition-owner", "a:1"),
+        ))) == (None, None)
+        assert _redirect_info(FakeErr((
+            ("cpzk-partition-map-version", "3"),
+        ))) == (None, None)
+        assert _redirect_info(FakeErr((
+            ("cpzk-partition-map-version", "garbage"),
+            ("cpzk-partition-owner", "a:1"),
+        ))) == (None, None)
+        assert _redirect_info(FakeErr((
+            ("cpzk-partition-map-version", b"3"),
+            ("cpzk-partition-owner", b"a:1"),
+        ))) == ("a:1", 3)
+
+
+# --- the split flow ---------------------------------------------------------
+
+
+async def _seed_partition(tmp_path, tag: str, users: int):
+    """A stopped partition's durable file set with ``users`` registered,
+    one session and one challenge mixed in."""
+    state = ServerState()
+    mgr = DurabilityManager(
+        state, DurabilitySettings(enabled=True, fsync="always"),
+        str(tmp_path / f"{tag}.json"),
+    )
+    await mgr.recover()
+    for i in range(users):
+        await state.register_user(
+            UserData(f"user-{i:03d}", make_statement(), 1)
+        )
+    await state.create_sessions([
+        (state.tag_session_token("user-000", "ab" * 32), "user-000"),
+    ])
+    cid = state.tag_challenge_id("user-001", rng.fill_bytes(32))
+    await state.create_challenge("user-001", cid)
+    await mgr.close()
+    return str(tmp_path / f"{tag}.json")
+
+
+async def _recovered(state_file: str) -> ServerState:
+    from cpzk_tpu.durability.recovery import recover_state
+
+    state = ServerState()
+    await recover_state(state, state_file, state_file + ".wal")
+    return state
+
+
+class TestSplit:
+    N_USERS = 30
+
+    def _assert_disjoint_exhaustive(self, s0, s1, newmap):
+        u0, u1 = set(s0._users), set(s1._users)
+        assert not (u0 & u1)
+        assert u0 | u1 == {f"user-{i:03d}" for i in range(self.N_USERS)}
+        for uid in u0:
+            assert newmap.partition_for(uid).index == 0
+        for uid in u1:
+            assert newmap.partition_for(uid).index == 1
+
+    def test_split_acceptance_disjoint_exhaustive_ownership(self, tmp_path):
+        async def main():
+            src = await _seed_partition(tmp_path, "p0", self.N_USERS)
+            tgt = str(tmp_path / "p1.json")
+            map_path = str(tmp_path / "map.json")
+            PartitionMap.uniform(["127.0.0.1:1"]).store(map_path)
+            report = await run_split(
+                map_path, 0, "127.0.0.1:2", src, tgt, segment_bytes=512
+            )
+            assert report["new_version"] == 2
+            assert report["segments"] >= 2  # small segments: real splits
+            assert report["moved_users"] == report["dropped_users"] > 0
+            newmap = PartitionMap.load(map_path)
+            assert newmap.version == 2
+            s0, s1 = await _recovered(src), await _recovered(tgt)
+            self._assert_disjoint_exhaustive(s0, s1, newmap)
+            # moved live session/challenge landed with their owners
+            sess_owner = newmap.partition_for("user-000").index
+            holder = (s0, s1)[sess_owner]
+            other = (s1, s0)[sess_owner]
+            assert len(holder._sessions) == 1
+            assert len(other._sessions) == 0
+            # the fencing epoch persisted for the new partition
+            from cpzk_tpu.replication import load_epoch
+
+            assert load_epoch(tgt + ".epoch") == report["epoch"] >= 2
+            assert not os.path.exists(manifest_path(map_path))
+
+        run(main())
+
+    @pytest.mark.parametrize("point", SPLIT_CRASH_POINTS)
+    def test_sigkill_at_any_stage_resumes_consistent(self, tmp_path, point):
+        """The chaos guarantee: a split killed at ANY stage leaves both
+        partitions' files in a state where (a) serving is already
+        non-overlapping (enforcement covers the flipped-but-undrained
+        window) and (b) re-running the same command completes to
+        disjoint, exhaustive ownership."""
+
+        async def main():
+            src = await _seed_partition(tmp_path, "p0", self.N_USERS)
+            tgt = str(tmp_path / "p1.json")
+            map_path = str(tmp_path / "map.json")
+            PartitionMap.uniform(["127.0.0.1:1"]).store(map_path)
+            plan = FaultPlan().crash_on(point)
+            with pytest.raises(CrashPoint):
+                await run_split(
+                    map_path, 0, "127.0.0.1:2", src, tgt,
+                    segment_bytes=512, faults=plan,
+                )
+            # the kill window is already safe: whatever the map says, at
+            # most one partition is authoritative for every user
+            mid = PartitionMap.load(map_path)
+            assert mid.version in (1, 2)
+            # resume with the identical command
+            report = await run_split(
+                map_path, 0, "127.0.0.1:2", src, tgt, segment_bytes=512
+            )
+            assert report["new_version"] == 2
+            newmap = PartitionMap.load(map_path)
+            assert newmap.version == 2
+            s0, s1 = await _recovered(src), await _recovered(tgt)
+            self._assert_disjoint_exhaustive(s0, s1, newmap)
+            assert not os.path.exists(manifest_path(map_path))
+
+        run(main())
+
+    def test_mismatched_resume_manifest_refused(self, tmp_path):
+        async def main():
+            src = await _seed_partition(tmp_path, "p0", 8)
+            tgt = str(tmp_path / "p1.json")
+            map_path = str(tmp_path / "map.json")
+            PartitionMap.uniform(["127.0.0.1:1"]).store(map_path)
+            plan = FaultPlan().crash_on("pre_copy")
+            with pytest.raises(CrashPoint):
+                await run_split(
+                    map_path, 0, "127.0.0.1:2", src, tgt, faults=plan
+                )
+            with pytest.raises(SplitError, match="different split"):
+                await run_split(
+                    map_path, 0, "127.0.0.1:OTHER", src, tgt
+                )
+
+        run(main())
+
+    def test_post_split_fleet_serves_and_stale_client_redirects(
+        self, tmp_path
+    ):
+        """Boot both partitions from the split's files, with routers on
+        the new map: every user logs in against the fleet, and a client
+        still holding the v1 map converges via one redirect."""
+        from cpzk_tpu.client.__main__ import do_login, do_register
+
+        async def main():
+            src = await _seed_partition(tmp_path, "p0", 6)
+            tgt = str(tmp_path / "p1.json")
+            map_path = str(tmp_path / "map.json")
+            PartitionMap.uniform(["127.0.0.1:1"]).store(map_path)
+            await run_split(map_path, 0, "127.0.0.1:2", src, tgt)
+
+            s0, s1 = await _recovered(src), await _recovered(tgt)
+            srv0, p0 = await serve(s0, RateLimiter(10**6, 10**6), port=0)
+            srv1, p1 = await serve(s1, RateLimiter(10**6, 10**6), port=0)
+            # the on-disk map carries placeholder addresses; re-address
+            # it to the live ports at the same version (deploy config)
+            disk = PartitionMap.load(map_path)
+            live = PartitionMap.from_doc({
+                "schema": "cpzk-partition-map/1",
+                "version": disk.version,
+                "partitions": [
+                    {"index": 0, "address": f"127.0.0.1:{p0}",
+                     "ranges": [list(r) for r in disk.partitions[0].ranges]},
+                    {"index": 1, "address": f"127.0.0.1:{p1}",
+                     "ranges": [list(r) for r in disk.partitions[1].ranges]},
+                ],
+            })
+            srv0.auth_service.fleet = FleetRouter(live, 0)
+            srv1.auth_service.fleet = FleetRouter(live, 1)
+            try:
+                # a fresh registration + login for a user on each side
+                c = AuthClient(partition_map=live)
+                for idx in (0, 1):
+                    uid = uid_on_partition(live, idx, tag="fresh")
+                    assert "Registered" in await do_register(c, uid, "pw")
+                    assert "Login OK" in await do_login(c, uid, "pw")
+                assert c.redirects == 0
+                await c.close()
+
+                # stale-map client: v1 routes everything to partition 0
+                moved = uid_on_partition(live, 1, tag="fresh")
+                stale = PartitionMap.uniform([f"127.0.0.1:{p0}"])
+                c2 = AuthClient(
+                    partition_map=stale,
+                    map_refresh=lambda: PartitionMap.from_doc(live.to_doc()),
+                )
+                assert "Login OK" in await do_login(c2, moved, "pw")
+                assert 1 <= c2.redirects <= 2  # <= 1 per RPC attempt
+                assert c2.partition_map.version == live.version
+                await c2.close()
+            finally:
+                await srv0.stop(None)
+                await srv1.stop(None)
+
+        run(main())
+
+
+# --- proof-log rotation + shipping (PR 9 tail) ------------------------------
+
+
+class TestAuditRotation:
+    def test_rotation_seals_and_resumes_numbering(self, tmp_path):
+        from cpzk_tpu.audit.log import (
+            ProofLogWriter, proof_record, read_log, sealed_segments,
+        )
+
+        path = str(tmp_path / "proofs.log")
+        w = ProofLogWriter(path, fsync="off", segment_bytes=512)
+        rec = lambda i: proof_record(  # noqa: E731
+            f"u{i}", b"\x01" * 32, b"\x02" * 32, b"c" * 32, b"p" * 64, True
+        )
+        for i in range(40):
+            w.append_proofs([rec(i)])
+        assert w.rotations >= 2
+        segs = sealed_segments(path)
+        assert len(segs) == w.rotations
+        assert segs == sorted(segs)
+        # sealed files parse clean; seqs strictly increase across files
+        prev = 0
+        for seg in segs:
+            records, valid, size = read_log(seg)
+            assert valid == size and records
+            assert records[0]["seq"] == prev + 1
+            prev = records[-1]["seq"]
+        st = w.status()
+        assert st["rotations_this_boot"] == w.rotations
+        assert st["sealed_segments"] == len(segs)
+        w.close()
+        # a reopened writer resumes numbering past sealed history
+        w2 = ProofLogWriter(path, fsync="off", segment_bytes=512)
+        assert w2.seq == 40
+        w2.append_proofs([rec(99)])
+        assert w2.seq == 41
+        w2.close()
+
+    def test_directory_replay_equals_single_log_replay(self, tmp_path):
+        """A rotated-segment directory audits to the byte-identical
+        digest of the same records in one unrotated log."""
+        from cpzk_tpu.audit.log import ProofLogWriter, proof_record
+        from cpzk_tpu.audit.pipeline import run_audit
+        from cpzk_tpu.core.transcript import Transcript
+
+        rot_dir = tmp_path / "rotated"
+        rot_dir.mkdir()
+        eb = Ristretto255.element_to_bytes
+        prover = Prover(params, Witness(Ristretto255.random_scalar(rng)))
+        payloads = []
+        for i in range(24):
+            ctx = rng.fill_bytes(32)
+            t = Transcript()
+            t.append_context(ctx)
+            wire = prover.prove_with_transcript(rng, t).to_bytes()
+            payloads.append(proof_record(
+                f"u{i % 4}", eb(prover.statement.y1),
+                eb(prover.statement.y2), ctx, wire, True,
+            ))
+        rot = ProofLogWriter(
+            str(rot_dir / "proofs.log"), fsync="off", segment_bytes=600
+        )
+        flat = ProofLogWriter(str(tmp_path / "flat.log"), fsync="off")
+        for p in payloads:
+            rot.append_proofs([dict(p)])
+            flat.append_proofs([dict(p)])
+        rot.close()
+        flat.close()
+        assert rot.rotations >= 2
+
+        rep_dir = run_audit(
+            str(rot_dir), str(tmp_path / "dir-report.json"), quantum=7
+        )
+        rep_flat = run_audit(
+            str(tmp_path / "flat.log"), str(tmp_path / "flat-report.json"),
+            quantum=7,
+        )
+        assert rep_dir["digest"] == rep_flat["digest"]
+        assert rep_dir["totals"] == rep_flat["totals"]
+        assert rep_dir["totals"]["verified"] == 24
+
+    def test_sealed_segments_survive_machine_death(self, tmp_path):
+        """The PR 9 tail, end to end: a rotating proof log on the
+        primary ships sealed segments to the standby; killing the
+        primary loses at most the unsealed active tail, and the
+        standby's copy replays clean."""
+        from cpzk_tpu.audit.log import (
+            ProofLogWriter, proof_record, sealed_segments,
+        )
+        from cpzk_tpu.audit.pipeline import run_audit
+
+        async def main():
+            pri = tmp_path / "pri"
+            sby = tmp_path / "sby"
+            pri.mkdir()
+            sby.mkdir()
+            w = ProofLogWriter(
+                str(pri / "proofs.log"), fsync="off", segment_bytes=512
+            )
+
+            sstate = ServerState()
+            smgr = DurabilityManager(
+                sstate, DurabilitySettings(enabled=True),
+                str(sby / "state.json"),
+            )
+            await smgr.recover()
+            replica = StandbyReplica(
+                sstate, smgr,
+                ReplicationSettings(
+                    enabled=True, role="standby", lease_ms=5000,
+                    renew_interval_ms=100,
+                ),
+                audit_path=str(sby / "proofs.log"),
+            )
+            sserver, sport = await serve(
+                sstate, RateLimiter(10**6, 10**6), port=0, replica=replica
+            )
+            pstate = ServerState()
+            pmgr = DurabilityManager(
+                pstate, DurabilitySettings(enabled=True),
+                str(pri / "state.json"),
+            )
+            await pmgr.recover()
+            shipper = SegmentShipper(
+                pstate, pmgr,
+                ReplicationSettings(
+                    enabled=True, role="primary",
+                    peer=f"127.0.0.1:{sport}", lease_ms=5000,
+                    renew_interval_ms=30, mode="async",
+                ),
+                audit_log=w,
+            )
+            shipper.start()
+            try:
+                from cpzk_tpu.core.transcript import Transcript
+
+                eb = Ristretto255.element_to_bytes
+                prover = Prover(
+                    params, Witness(Ristretto255.random_scalar(rng))
+                )
+                for i in range(30):
+                    ctx = rng.fill_bytes(32)
+                    t = Transcript()
+                    t.append_context(ctx)
+                    wire = prover.prove_with_transcript(rng, t).to_bytes()
+                    w.append_proofs([proof_record(
+                        f"u{i}", eb(prover.statement.y1),
+                        eb(prover.statement.y2), ctx, wire, True,
+                    )])
+                n_sealed = len(w.sealed_segments())
+                assert n_sealed >= 2
+                await wait_for(
+                    lambda: shipper.audit_segments_shipped >= n_sealed
+                )
+                assert replica.audit_segments_received >= n_sealed
+                assert shipper.status()["audit_segments_shipped"] >= n_sealed
+                assert (
+                    replica.status()["audit_segments_received"] >= n_sealed
+                )
+                # SIGKILL stand-in: the primary vanishes, unsealed tail
+                # and all; the standby's sealed copies are intact and
+                # byte-identical...
+                await shipper.kill()
+                got = sealed_segments(str(sby / "proofs.log"))
+                assert len(got) == n_sealed
+                for a, b in zip(sorted(w.sealed_segments()), got,
+                                strict=True):
+                    with open(a, "rb") as fa, open(b, "rb") as fb:
+                        assert fa.read() == fb.read()
+                # ...and the standby's segment directory audits clean
+                report = run_audit(
+                    str(sby), str(tmp_path / "sby-report.json"), quantum=8
+                )
+                assert report["totals"]["skipped"] == 0
+                assert report["totals"]["mismatched"] == 0
+                assert report["totals"]["audited"] > 0
+            finally:
+                await shipper.kill()
+                await replica.stop()
+                await sserver.stop(None)
+
+        run(main())
+
+    def test_stale_epoch_audit_segment_is_fenced(self, tmp_path):
+        from cpzk_tpu.audit.log import ProofLogWriter, proof_record
+
+        async def main():
+            sby = tmp_path / "sby"
+            sby.mkdir()
+            sstate = ServerState()
+            smgr = DurabilityManager(
+                sstate, DurabilitySettings(enabled=True),
+                str(sby / "state.json"),
+            )
+            await smgr.recover()
+            replica = StandbyReplica(
+                sstate, smgr,
+                ReplicationSettings(
+                    enabled=True, role="standby", lease_ms=5000,
+                    renew_interval_ms=100,
+                ),
+                audit_path=str(sby / "proofs.log"),
+            )
+            replica.applier.epoch = 5  # a promotion happened elsewhere
+            pb2 = replica.pb2
+            req = pb2.ShipSegmentRequest(
+                epoch=3, kind="audit", frames=b"junk", crc32=0,
+                first_seq=1, last_seq=1,
+            )
+            resp = await replica.ship_segment(req, None)
+            assert not resp.accepted and "fenced" in resp.message
+            # a standby without an audit plane refuses rather than drops
+            replica.audit_path = None
+            req2 = pb2.ShipSegmentRequest(
+                epoch=5, kind="audit", frames=b"junk", crc32=0,
+                first_seq=1, last_seq=1,
+            )
+            resp2 = await replica.ship_segment(req2, None)
+            assert not resp2.accepted and "no audit plane" in resp2.message
+
+        run(main())
+
+
+# --- chaos acceptance: 3-partition fleet ------------------------------------
+
+
+class TestFleetChaos:
+    def test_kill_one_partition_others_serve_uninterrupted(self, tmp_path):
+        """THE fleet acceptance scenario: partition 0 is a replicated
+        pair (sync mode, fsync=always); partitions 1 and 2 are plain
+        primaries.  SIGKILL partition 0's primary mid-traffic — its
+        standby auto-promotes and completes a pre-crash user's login
+        with zero acknowledged loss, while logins against partitions 1
+        and 2 NEVER error through the whole window; a stale-map client
+        is redirected and completes its login."""
+        from cpzk_tpu.client.__main__ import do_login, do_register
+
+        async def main():
+            # partition 0: primary + warm standby over real gRPC
+            sstate = ServerState()
+            smgr = DurabilityManager(
+                sstate, DurabilitySettings(enabled=True, fsync="always"),
+                str(tmp_path / "p0-standby.json"),
+            )
+            await smgr.recover()
+            replica = StandbyReplica(
+                sstate, smgr,
+                ReplicationSettings(
+                    enabled=True, role="standby", lease_ms=400,
+                    renew_interval_ms=40, mode="sync",
+                ),
+            )
+            sserver, sport = await serve(
+                sstate, RateLimiter(10**6, 10**6), port=0, replica=replica
+            )
+            replica.start()
+
+            pstate = ServerState()
+            pmgr = DurabilityManager(
+                pstate, DurabilitySettings(enabled=True, fsync="always"),
+                str(tmp_path / "p0-primary.json"),
+            )
+            await pmgr.recover()
+            shipper = SegmentShipper(
+                pstate, pmgr,
+                ReplicationSettings(
+                    enabled=True, role="primary",
+                    peer=f"127.0.0.1:{sport}", lease_ms=400,
+                    renew_interval_ms=40, mode="sync",
+                ),
+            )
+            pmgr.attach_shipper(shipper)
+            pstate.attach_replication_barrier(shipper.wait_replicated)
+            pserver, pport = await serve(
+                pstate, RateLimiter(10**6, 10**6), port=0
+            )
+            shipper.start()
+
+            # partitions 1 and 2: plain primaries
+            s1, s2 = ServerState(), ServerState()
+            srv1, port1 = await serve(s1, RateLimiter(10**6, 10**6), port=0)
+            srv2, port2 = await serve(s2, RateLimiter(10**6, 10**6), port=0)
+
+            pmap = PartitionMap.uniform([
+                f"127.0.0.1:{pport}",
+                f"127.0.0.1:{port1}",
+                f"127.0.0.1:{port2}",
+            ])
+            pserver.auth_service.fleet = FleetRouter(pmap, 0)
+            srv1.auth_service.fleet = FleetRouter(pmap, 1)
+            srv2.auth_service.fleet = FleetRouter(pmap, 2)
+
+            u0 = uid_on_partition(pmap, 0)
+            # login pools for the surviving partitions: each user mints
+            # at most 4 sessions (the server caps at 5 per user), so the
+            # traffic loop cycles users instead of tripping the cap
+            pools = {
+                1: [uid_on_partition(pmap, 1, tag=f"s{k}-") for k in range(5)],
+                2: [uid_on_partition(pmap, 2, tag=f"s{k}-") for k in range(5)],
+            }
+            logins_done: dict[str, int] = {}
+
+            survivor_errors: list[str] = []
+            stop_traffic = asyncio.Event()
+
+            async def survivor_traffic():
+                c = AuthClient(partition_map=pmap)
+                k = 0
+                try:
+                    while not stop_traffic.is_set():
+                        for idx in (1, 2):
+                            uid = pools[idx][k % len(pools[idx])]
+                            if logins_done.get(uid, 0) >= 4:
+                                continue
+                            out = await do_login(c, uid, "pw-" + uid)
+                            logins_done[uid] = logins_done.get(uid, 0) + 1
+                            if "Login OK" not in out:
+                                survivor_errors.append(out)
+                        k += 1
+                        await asyncio.sleep(0.01)
+                finally:
+                    await c.close()
+
+            try:
+                c = AuthClient(partition_map=pmap)
+                for uid in [u0] + pools[1] + pools[2]:
+                    assert "Registered" in await do_register(
+                        c, uid, "pw-" + uid
+                    )
+                out = await do_login(c, u0, "pw-" + u0)
+                assert "Login OK" in out
+                await c.close()
+                # every acknowledged p0 write is standby-applied (sync)
+                assert replica.applied_seq == pmgr.wal.seq
+
+                traffic = asyncio.get_running_loop().create_task(
+                    survivor_traffic()
+                )
+                await asyncio.sleep(0.1)
+
+                # SIGKILL stand-in for partition 0's primary
+                await shipper.kill()
+                await pserver.stop(None)
+
+                # its standby promotes within the lease window...
+                await wait_for(lambda: replica.role == "primary")
+                assert replica.epoch == 2
+
+                # ...while the other two partitions served throughout
+                await asyncio.sleep(0.2)
+                stop_traffic.set()
+                await traffic
+                assert not survivor_errors, survivor_errors[:3]
+                assert sum(logins_done.values()) >= 4  # real coverage
+
+                # the promoted standby serves partition 0's users with
+                # zero acknowledged loss (fresh full login)
+                async with AuthClient(f"127.0.0.1:{sport}") as c2:
+                    assert "Login OK" in await do_login(c2, u0, "pw-" + u0)
+
+                # stale-map client: still routing p0's user at the dead
+                # primary's address; the updated map (v2, promoted
+                # standby's address) arrives via its refresh hook and
+                # the login completes
+                promoted = PartitionMap.from_doc({
+                    "schema": "cpzk-partition-map/1",
+                    "version": 2,
+                    "partitions": [
+                        {"index": 0, "address": f"127.0.0.1:{sport}",
+                         "ranges": [list(r)
+                                    for r in pmap.partitions[0].ranges]},
+                        {"index": 1, "address": f"127.0.0.1:{port1}",
+                         "ranges": [list(r)
+                                    for r in pmap.partitions[1].ranges]},
+                        {"index": 2, "address": f"127.0.0.1:{port2}",
+                         "ranges": [list(r)
+                                    for r in pmap.partitions[2].ranges]},
+                    ],
+                })
+                sserver.auth_service.fleet = FleetRouter(promoted, 0)
+                srv1.auth_service.fleet = FleetRouter(promoted, 1)
+                srv2.auth_service.fleet = FleetRouter(promoted, 2)
+                # route a p0 user at partition 1 by handing the stale
+                # client a map that owns everything at partition 1
+                stale = PartitionMap.uniform([f"127.0.0.1:{port1}"])
+                c3 = AuthClient(
+                    partition_map=stale, map_refresh=lambda: promoted
+                )
+                assert "Login OK" in await do_login(c3, u0, "pw-" + u0)
+                assert 1 <= c3.redirects <= 2
+                assert c3.partition_map.version == 2
+                await c3.close()
+            finally:
+                stop_traffic.set()
+                await shipper.kill()
+                await replica.stop()
+                await sserver.stop(None)
+                await srv1.stop(None)
+                await srv2.stop(None)
+
+        run(main())
+
+
+# --- config surface ---------------------------------------------------------
+
+
+class TestFleetConfig:
+    def test_env_layering_and_validation(self, tmp_path, monkeypatch):
+        map_path = str(tmp_path / "map.json")
+        PartitionMap.uniform(["a:1"]).store(map_path)
+        monkeypatch.setenv("SERVER_CONFIG_PATH", str(tmp_path / "none.toml"))
+        monkeypatch.setenv("SERVER_FLEET_ENABLED", "1")
+        monkeypatch.setenv("SERVER_FLEET_MAP_PATH", map_path)
+        monkeypatch.setenv("SERVER_FLEET_PARTITION", "0")
+        monkeypatch.setenv("SERVER_FLEET_ADVERTISE", "a:1")
+        cfg = ServerConfig.from_env()
+        assert cfg.fleet.enabled is True
+        assert cfg.fleet.map_path == map_path
+        assert cfg.fleet.partition == 0
+        assert cfg.fleet.advertise == "a:1"
+        cfg.validate()
+
+        bad = ServerConfig()
+        bad.fleet.enabled = True
+        with pytest.raises(ValueError, match="map_path"):
+            bad.validate()
+        bad2 = ServerConfig()
+        bad2.fleet.partition = -2
+        with pytest.raises(ValueError, match="partition"):
+            bad2.validate()
+        bad3 = ServerConfig()
+        bad3.audit.segment_bytes = -1
+        with pytest.raises(ValueError, match="segment_bytes"):
+            bad3.validate()
+
+    def test_fleet_config_keys_documented(self):
+        """CI drift guard: every [fleet] knob ships in the TOML example,
+        the .env example, and the operations-doc knob inventory."""
+        keys = [f.name for f in dataclasses.fields(FleetSettings)]
+        assert keys
+
+        toml_text = (ROOT / "config" / "server.toml.example").read_text()
+        m = re.search(r"^\[fleet\]$", toml_text, re.M)
+        assert m, "[fleet] section missing from config/server.toml.example"
+        section = toml_text[m.end():].split("\n[", 1)[0]
+        env_text = (ROOT / ".env.example").read_text()
+        docs = (ROOT / "docs" / "operations.md").read_text()
+        for key in keys:
+            assert re.search(rf"^{key}\s*=", section, re.M), (
+                f"[fleet] key {key!r} missing from config/server.toml.example"
+            )
+            assert f"SERVER_FLEET_{key.upper()}" in env_text, (
+                f"SERVER_FLEET_{key.upper()} missing from .env.example"
+            )
+            assert f"`fleet.{key}`" in docs, (
+                f"`fleet.{key}` missing from the docs/operations.md "
+                "knob inventory"
+            )
+
+    def test_repl_fleet_command(self, tmp_path):
+        from cpzk_tpu.server.__main__ import handle_command
+
+        async def main():
+            state = ServerState()
+            out, _ = await handle_command("/fleet", state)
+            assert "fleet routing disabled" in out
+
+            map_path = str(tmp_path / "map.json")
+            v1 = PartitionMap.uniform(["a:1", "b:2"])
+            v1.store(map_path)
+            router = FleetRouter(v1, 1, map_path=map_path)
+            out, _ = await handle_command(
+                "/fleet", state, None, None, None, None, None, router
+            )
+            assert "partition=1/2" in out and "map=v1" in out
+            out, _ = await handle_command(
+                "/fleet reload", state, None, None, None, None, None, router
+            )
+            assert "map unchanged" in out
+            v2, _ = v1.split(0, "c:3")
+            v2.store(map_path)
+            out, _ = await handle_command(
+                "/fleet reload", state, None, None, None, None, None, router
+            )
+            assert "map=v2" in out and "partition=1/3" in out
+
+        run(main())
+
+    def test_statusz_and_partitionmap_endpoint(self, tmp_path):
+        """The ops plane serves the fleet rollup and the canonical map —
+        and the map body round-trips through the client-side validator
+        (so map_refresh can point straight at /partitionmap)."""
+        import urllib.error
+        import urllib.request
+
+        from cpzk_tpu.observability.opsplane import OpsPlane, OpsSources
+        from cpzk_tpu.observability.slo import SloEngine
+        from cpzk_tpu.server.config import SloSettings
+
+        async def main():
+            pmap, _ = PartitionMap.uniform(["a:1", "b:2"]).split(1, "c:3")
+            router = FleetRouter(pmap, 2)
+            engine = SloEngine(SloSettings())
+            engine.partition = "2"
+            plane = OpsPlane(
+                OpsSources(fleet=router, slo=engine), port=0
+            )
+            port = await plane.start()
+
+            def get(path):
+                with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}{path}"
+                ) as r:
+                    return json.loads(r.read())
+
+            try:
+                statusz = await asyncio.to_thread(get, "/statusz")
+                assert statusz["fleet"]["partition"] == 2
+                assert statusz["fleet"]["map_version"] == 2
+                assert statusz["fleet"]["partitions"] == 3
+
+                doc = await asyncio.to_thread(get, "/partitionmap")
+                fetched = PartitionMap.from_doc(doc)
+                assert fetched.version == 2
+                assert fetched.digest == pmap.digest
+
+                slo = await asyncio.to_thread(get, "/slo")
+                assert slo["partition"] == "2"
+
+                # without a fleet source the endpoint 404s with a reason
+                bare = OpsPlane(OpsSources(), port=0)
+                bport = await bare.start()
+
+                def get_bare():
+                    return urllib.request.urlopen(
+                        f"http://127.0.0.1:{bport}/partitionmap"
+                    ).read()
+
+                try:
+                    with pytest.raises(urllib.error.HTTPError) as exc:
+                        await asyncio.to_thread(get_bare)
+                    assert exc.value.code == 404
+                finally:
+                    await bare.stop()
+            finally:
+                await plane.stop()
+
+        run(main())
+
+    def test_fleet_gauges_exported(self):
+        FleetRouter(PartitionMap.uniform(["a:1", "b:2"]), 1)
+        assert metrics.read("fleet.partition", "g") == 1.0
+        assert metrics.read("fleet.map_version", "g") == 1.0
