@@ -72,8 +72,48 @@ def build_corpus():
 STREAM_CHUNK = 1024    # entries packed per stream message
 
 
+def build_serving_plane(backend_name: str, lanes: int, quantum: int):
+    """(backend, router, resolved_lanes): the serving compute plane of
+    one curve point.  ``lanes != 1`` builds the multi-chip LaneRouter —
+    per-device ``TpuBackend`` lanes on the tpu backend (emulate chips on
+    CPU with ``XLA_FLAGS=--xla_force_host_platform_device_count=8``),
+    per-host-core ``CpuBackend`` lanes on the cpu backend (the router
+    machinery at native verify speeds — what the perf gate's lanes leg
+    measures)."""
+    if backend_name == "tpu":
+        from cpzk_tpu.ops.backend import TpuBackend, prewarm_executables
+
+        if lanes != 1:
+            from cpzk_tpu.parallel import resolve_lane_devices
+            from cpzk_tpu.server.router import LaneRouter
+
+            devices = resolve_lane_devices(lanes)
+            if devices is not None:
+                # per-device AOT prewarm: every lane's first timed batch
+                # books jit HITs, like a production [tpu] prewarm_quanta
+                prewarm_executables([quantum], devices=devices)
+                backends = [TpuBackend(device=d) for d in devices]
+                router = LaneRouter(backends, devices=devices)
+                return backends[0], router, len(devices)
+        prewarm_executables([quantum])
+        return TpuBackend(), None, 1
+    from cpzk_tpu.protocol.batch import CpuBackend
+
+    if lanes != 1:
+        from cpzk_tpu.server.router import LaneRouter
+
+        k = lanes if lanes > 0 else (os.cpu_count() or 1)
+        if k > 1:
+            return (
+                CpuBackend(),
+                LaneRouter([CpuBackend() for _ in range(k)]),
+                k,
+            )
+    return CpuBackend(), None, 1
+
+
 async def grpc_curve_point(
-    n: int, provers, rng, backend_name: str
+    n: int, provers, rng, backend_name: str, lanes: int = 1
 ) -> tuple[float, float, float]:
     """(serial_pps, pipelined_pps, stream_pps): wall time of the timed
     verify RPCs for n proofs with one RPC in flight, then with each
@@ -89,25 +129,17 @@ async def grpc_curve_point(
 
     from cpzk_tpu.server.batching import DynamicBatcher
 
-    backend = None
-    if backend_name == "tpu":
-        from cpzk_tpu.ops.backend import TpuBackend, prewarm_executables
-
-        # AOT-prewarm the dominant batch quantum (what a production
-        # server does via [tpu] prewarm_quanta) so the timed passes
-        # exercise the steady-state zero-compile dispatch path
-        prewarm_executables([min(n, RPC_CAP)])
-        backend = TpuBackend()
-    else:
-        from cpzk_tpu.protocol.batch import CpuBackend
-
-        backend = CpuBackend()
+    backend, router, _ = build_serving_plane(
+        backend_name, lanes, min(n, RPC_CAP)
+    )
     # BOTH backends serve through the batcher -> dispatch-lane seam (the
     # production serving architecture since the dedicated-lane PR); the
     # flight recorder therefore has stage percentiles for the snapshot
-    # on the CPU path too, not only on device runs
+    # on the CPU path too, not only on device runs.  With lanes != 1 the
+    # batcher places every settled batch through the LaneRouter instead.
     batcher = DynamicBatcher(backend, max_batch=RPC_CAP, window_ms=5.0,
-                             pipeline_depth=2)  # serve() starts it
+                             pipeline_depth=2,  # serve() starts it
+                             router=router)
 
     state = ServerState()
     # CPZK_BENCH_FLEET=1: enable fleet routing with a single-partition
@@ -284,6 +316,14 @@ def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--ns", default=os.environ.get("CPZK_E2E_NS", ""))
     ap.add_argument("--backend", default="cpu", choices=["cpu", "tpu"])
+    ap.add_argument("--lanes", type=int, default=1,
+                    help="serve through N per-device dispatch lanes "
+                         "behind the LaneRouter (-1 = one per local "
+                         "device / host core; emulate devices on CPU "
+                         "with XLA_FLAGS=--xla_force_host_platform_"
+                         "device_count=8).  Entries carry the lane "
+                         "count as a perf-gate config key, so a new "
+                         "lane count seeds its own trajectory")
     ap.add_argument("--snapshot", default=None,
                     help="also write a cpzk-perf-snapshot JSON here "
                          "(throughput per n + flight-recorder stage "
@@ -330,10 +370,19 @@ def main() -> None:
         recorder.clear()  # stage percentiles attribute to this n only
         direct = direct_curve_point(n, provers, rng, params, args.backend)
         grpc_pps, grpc_pipelined, stream_pps = asyncio.run(
-            grpc_curve_point(n, provers, rng, args.backend))
+            grpc_curve_point(n, provers, rng, args.backend,
+                             lanes=args.lanes))
+        resolved_lanes = args.lanes
+        if args.lanes == -1:
+            # report the resolved count, not the sentinel
+            if args.backend == "tpu":
+                resolved_lanes = jax.local_device_count()
+            else:
+                resolved_lanes = os.cpu_count() or 1
         print(json.dumps({
             "metric": "e2e_curve",
             "n": n,
+            "lanes": resolved_lanes,
             "grpc_pps": round(grpc_pps, 1),
             "grpc_pipelined_pps": round(grpc_pipelined, 1),
             "stream_pps": round(stream_pps, 1),
@@ -353,6 +402,7 @@ def main() -> None:
             snapshot_entries.append(PerfEntry(
                 name=name, backend=args.backend, n=n,
                 value=round(pps, 2), unit="proofs/s",
+                lanes=resolved_lanes,
                 stages_ms=stages if name.startswith("e2e_curve.grpc") else {},
             ))
 

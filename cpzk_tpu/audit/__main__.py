@@ -122,6 +122,7 @@ def cmd_run(args) -> int:
             quantum=args.quantum,
             backend=args.backend,
             mesh_devices=args.mesh_devices,
+            lanes=args.lanes,
             resume=not args.fresh,
             max_batches=args.max_batches,
             progress=progress,
@@ -185,6 +186,13 @@ def build_parser() -> argparse.ArgumentParser:
     r.add_argument("--backend", choices=("cpu", "tpu"), default="cpu")
     r.add_argument("--mesh-devices", type=int, default=0,
                    help="0 = all visible devices (tpu backend)")
+    r.add_argument("--lanes", type=int, default=1,
+                   help="replay through the serving LaneRouter: -1 = one "
+                        "dispatch lane per local device (tpu) or host "
+                        "core (cpu), k = exactly k lanes, 1 = direct "
+                        "single-engine replay (each quantum fans out "
+                        "across the lanes; the signed report is "
+                        "byte-identical either way)")
     r.add_argument("--fresh", action="store_true",
                    help="ignore an existing cursor and restart from byte 0")
     r.add_argument("--max-batches", type=int, default=None,

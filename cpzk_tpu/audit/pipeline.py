@@ -231,6 +231,44 @@ def build_backend(backend_name: str, mesh_devices: int = 0):
     return CpuBackend()
 
 
+def build_router(backend_name: str, lanes: int, quantum: int):
+    """The audit pipeline's multi-lane compute plane — the SAME
+    :class:`~cpzk_tpu.server.router.LaneRouter` the serving daemon
+    places batches on, attached via its synchronous seam
+    (``verify_blocking``): each quantum fans out across every lane, so
+    a bulk replay is the first consumer that can saturate all chips.
+
+    ``lanes`` semantics match ``[tpu] lanes``: 1 = no router (the
+    single-engine path), -1 = one lane per local device (tpu backend) or
+    per host core (cpu backend), k = exactly k lanes.  Returns None when
+    one lane resolves — the caller keeps the direct ``verify_once``
+    path.  The per-lane prewarm runs here (tpu backend) so the replay's
+    first quantum per lane books jit HITs like serving traffic."""
+    if lanes == 1:
+        return None
+    from ..server.router import LaneRouter
+
+    if backend_name == "tpu":
+        from ..ops.backend import TpuBackend, prewarm_executables
+        from ..parallel import resolve_lane_devices
+
+        devices = resolve_lane_devices(lanes)
+        if devices is None:
+            return None
+        prewarm_executables([quantum], devices=devices)
+        return LaneRouter(
+            [TpuBackend(device=d) for d in devices], devices=devices,
+        )
+    from ..protocol.batch import CpuBackend
+
+    n = lanes if lanes > 0 else (os.cpu_count() or 1)
+    if n <= 1:
+        return None
+    # CPU lanes: the native verify releases the GIL, so N lanes = real
+    # host-core parallelism through the identical router seam
+    return LaneRouter([CpuBackend() for _ in range(n)])
+
+
 def _record_entry(rec: dict) -> tuple[BatchEntry | None, str | None]:
     """(entry, skip_reason): decode one validated proof record into a
     batch entry, or say why it cannot be audited.  A proof wire that
@@ -267,6 +305,7 @@ def run_audit(
     quantum: int = DEFAULT_QUANTUM,
     backend: str = "cpu",
     mesh_devices: int = 0,
+    lanes: int = 1,
     resume: bool = True,
     max_batches: int | None = None,
     progress=None,
@@ -285,6 +324,13 @@ def run_audit(
     sealed ``*.seg`` files plus the active tail replay as one logical
     log, cursor offsets indexing into their concatenation (stable:
     sealing only renames bytes in place within the order).
+
+    ``lanes != 1`` replays through the serving plane's
+    :class:`~cpzk_tpu.server.router.LaneRouter` — each quantum fans out
+    across every per-device lane concurrently.  Outcomes fold into the
+    digest chain in record order regardless of which lane computed them,
+    so the signed report is byte-identical to a single-lane run
+    (test-pinned).
     """
     if quantum < 1:
         raise ValueError("audit quantum must be positive")
@@ -302,7 +348,10 @@ def run_audit(
             f"({len(buf)} bytes) — wrong log file?"
         )
 
-    engine = build_backend(backend, mesh_devices=mesh_devices)
+    router = build_router(backend, lanes, quantum)
+    engine = None if router is not None else build_backend(
+        backend, mesh_devices=mesh_devices
+    )
     rng = SecureRng()
     # ONE scan of the remaining suffix (the parse cost is linear in what
     # is left, not quadratic in batch count); quanta then slice the
@@ -312,17 +361,26 @@ def run_audit(
     )
     batches = 0
     idx = 0
-    while idx < len(records):
-        batch = records[idx: idx + quantum]
-        idx += len(batch)
-        _audit_batch(batch, state, engine, rng)
-        state.offset = _advance(buf, state.offset, len(batch))
-        batches += 1
-        _atomic_write_json(cursor_path, state.to_cursor(log_path))
-        if progress is not None:
-            progress(state)
-        if max_batches is not None and batches >= max_batches and idx < len(records):
-            return None
+    if router is not None:
+        router.start_in_thread()
+    try:
+        while idx < len(records):
+            batch = records[idx: idx + quantum]
+            idx += len(batch)
+            _audit_batch(batch, state, engine, rng, router=router)
+            state.offset = _advance(buf, state.offset, len(batch))
+            batches += 1
+            _atomic_write_json(cursor_path, state.to_cursor(log_path))
+            if progress is not None:
+                progress(state)
+            if (
+                max_batches is not None and batches >= max_batches
+                and idx < len(records)
+            ):
+                return None
+    finally:
+        if router is not None:
+            router.stop_thread()
     state.offset = max(state.offset, valid)
 
     report = _build_report(
@@ -352,9 +410,13 @@ def _advance(buf: bytes, offset: int, n_frames: int) -> int:
     return off
 
 
-def _audit_batch(records: list[dict], state: AuditState, engine, rng) -> None:
-    """Verify one quantum of records through the serving dispatch seam
-    and fold the outcomes into ``state`` IN RECORD ORDER."""
+def _audit_batch(
+    records: list[dict], state: AuditState, engine, rng, router=None
+) -> None:
+    """Verify one quantum of records through the serving dispatch seam —
+    the direct ``verify_once`` engine, or the lane router's synchronous
+    fan-out (``verify_blocking``) — and fold the outcomes into ``state``
+    IN RECORD ORDER (lane placement never reorders the fold)."""
     from ..server.dispatch import DispatchLane
 
     entries: list[BatchEntry] = []
@@ -384,9 +446,12 @@ def _audit_batch(records: list[dict], state: AuditState, engine, rng) -> None:
             continue
         entry.proof = proof
         live.append(entry)
-    results = (
-        DispatchLane.verify_once(engine, rng, live) if live else []
-    )
+    if not live:
+        results = []
+    elif router is not None:
+        results = router.verify_blocking(live)
+    else:
+        results = DispatchLane.verify_once(engine, rng, live)
     it = iter(results)
     for rec, skip, parse_fail in plan:
         if skip is not None:

@@ -131,6 +131,12 @@ class OpsSources:
             "stage_percentiles_ms": stage_percentiles(records),
         }
 
+        # multi-chip serving plane: one row per dispatch lane (breaker
+        # state, depth, dispatches, drain rate) + the mesh lane when the
+        # big-batch path is configured; null on single-lane hosts
+        router = getattr(batcher, "router", None) if batcher is not None else None
+        doc["lanes"] = router.status() if router is not None else None
+
         state = self.state
         if state is not None and hasattr(state, "shard_stats"):
             shards = state.shard_stats()

@@ -47,7 +47,14 @@ PERCENTILES = (50, 90, 99)
 
 @dataclass
 class PerfEntry:
-    """One measured configuration of one benchmark."""
+    """One measured configuration of one benchmark.
+
+    ``lanes`` is a config-key component (the multi-chip serving plane's
+    dispatch-lane count): entries measured at different lane counts gate
+    independently, and because absent keys never gate, the first
+    snapshot carrying a new lane count seeds its trajectory instead of
+    failing CI.  Baselines written before the key existed load as
+    ``lanes=1`` — exactly the configuration they measured."""
 
     name: str
     backend: str
@@ -55,10 +62,11 @@ class PerfEntry:
     value: float
     unit: str
     spread: float = 0.0  # max-min over repeat runs, same unit as value
+    lanes: int = 1
     stages_ms: dict[str, dict[str, float]] = field(default_factory=dict)
 
-    def key(self) -> tuple[str, str, int, str]:
-        return (self.name, self.backend, self.n, self.unit)
+    def key(self) -> tuple[str, str, int, str, int]:
+        return (self.name, self.backend, self.n, self.unit, self.lanes)
 
     def to_dict(self) -> dict:
         out = {
@@ -69,6 +77,8 @@ class PerfEntry:
             "unit": self.unit,
             "spread": self.spread,
         }
+        if self.lanes != 1:
+            out["lanes"] = self.lanes
         if self.stages_ms:
             out["stages_ms"] = self.stages_ms
         return out
@@ -82,6 +92,7 @@ class PerfEntry:
             value=float(data["value"]),
             unit=str(data.get("unit", "ms/batch")),
             spread=max(0.0, float(data.get("spread", 0.0))),
+            lanes=int(data.get("lanes", 1)),
             stages_ms=dict(data.get("stages_ms", {})),
         )
 
@@ -144,7 +155,7 @@ def stage_percentiles(
 class Delta:
     """One compared entry: relative change, adjusted gate, verdict."""
 
-    key: tuple[str, str, int, str]
+    key: tuple[str, str, int, str, int]
     old: float
     new: float
     change: float      # relative move in the BAD direction (>0 = worse)
@@ -152,10 +163,12 @@ class Delta:
     regressed: bool
 
     def describe(self) -> str:
-        name, backend, n, unit = self.key
+        name, backend, n, unit, lanes = self.key
+        lane_tag = f"/lanes={lanes}" if lanes != 1 else ""
         arrow = "WORSE" if self.change > 0 else "better"
         return (
-            f"{name}/{backend}/n={n}: {self.old:g} -> {self.new:g} {unit} "
+            f"{name}/{backend}/n={n}{lane_tag}: "
+            f"{self.old:g} -> {self.new:g} {unit} "
             f"({abs(self.change) * 100:.1f}% {arrow}, "
             f"gate {self.limit * 100:.1f}%)"
         )

@@ -327,6 +327,9 @@ class BatchStages:
         self.batch_size = batch_size
         self.backend_label = backend_label
         self.queue_wait_s = queue_wait_s
+        #: dispatch-lane index, stamped by the LaneRouter at placement
+        #: time ("mesh" for the big-batch mesh path; None = single-lane)
+        self.lane: int | str | None = None
         #: accumulated seconds per stage name (incl. the widened vocab)
         self.durations: dict[str, float] = {}
         self._submitted_at: float | None = None
@@ -457,6 +460,7 @@ class BatchStages:
         occupancy = (rows / lanes) if lanes > 0 else 1.0
         rec = FlightRecord(
             batch=self.batch_size,
+            lane=self.lane,
             lanes=lanes,
             occupancy=occupancy,
             pad_waste=max(0.0, 1.0 - occupancy),

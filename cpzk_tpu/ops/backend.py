@@ -24,6 +24,7 @@ matches the reference bit-for-bit (SURVEY.md §3.2).
 
 from __future__ import annotations
 
+import contextlib
 import os
 import threading
 import time
@@ -140,18 +141,61 @@ def _note_marshal(t0: float) -> None:
         pass
 
 
+#: Per-thread device pin.  A per-device dispatch lane's backend enters
+#: :func:`device_scope` around every verify call, which (a) makes
+#: ``jax.default_device`` target that chip for the thread (staging
+#: transfers AND jit executions land there) and (b) stamps the thread's
+#: device key into every jit/AOT cache key below — XLA compiles one
+#: executable PER device, so a cache that ignored the device would book
+#: phantom hits on lanes 1..N-1 and the prewarm would warm only lane 0
+#: (the ISSUE 12 prewarm bug).
+_ACTIVE_DEVICE = threading.local()
+
+
+def _device_key() -> str | None:
+    """The jit/AOT cache-key suffix of the thread's pinned device (None
+    outside :func:`device_scope` — the default-device fast path keeps its
+    historical unsuffixed keys)."""
+    return getattr(_ACTIVE_DEVICE, "key", None)
+
+
+@contextlib.contextmanager
+def device_scope(device):
+    """Pin this thread's dispatches (staging, jit, AOT lookup) to one jax
+    device.  ``None`` is a no-op, so single-device callers pay nothing."""
+    if device is None:
+        yield
+        return
+    prev = getattr(_ACTIVE_DEVICE, "key", None)
+    _ACTIVE_DEVICE.key = f"dev{device.id}"
+    try:
+        with jax.default_device(device):
+            yield
+    finally:
+        _ACTIVE_DEVICE.key = prev
+
+
+def _scoped_key(key: tuple) -> tuple:
+    dk = _device_key()
+    return key if dk is None else key + (dk,)
+
+
 #: First-sight registry of jitted device programs, keyed by (kernel name,
-#: static args, padded shape) — the cache key the flight recorder uses to
-#: attribute a dispatch's cost to ``compile`` (first sight of a padded
-#: shape pays an XLA trace+compile) vs ``execute``.  Guarded: pipelined
-#: batches call the backend from multiple worker threads.
+#: static args, padded shape[, device]) — the cache key the flight
+#: recorder uses to attribute a dispatch's cost to ``compile`` (first
+#: sight of a padded shape pays an XLA trace+compile) vs ``execute``.
+#: The device component appears only under :func:`device_scope` (per-lane
+#: dispatch): XLA compiles per device, so first-sights are per-device
+#: facts.  Guarded: pipelined batches call the backend from multiple
+#: worker threads.
 _JIT_SEEN: set[tuple] = set()
 _JIT_LOCK = threading.Lock()
 
 
 def _jit_first_sight(*key) -> bool:
     """Register one jitted-program dispatch; True when this process has
-    never dispatched this (kernel, shape) before."""
+    never dispatched this (kernel, shape) on this thread's device before."""
+    key = _scoped_key(key)
     with _JIT_LOCK:
         first = key not in _JIT_SEEN
         if first:
@@ -165,25 +209,30 @@ def _jit_first_sight(*key) -> bool:
     return first
 
 
-#: Pre-lowered executables per (kernel, padded shape), keyed like
-#: ``_JIT_SEEN``.  Populated by :func:`prewarm_executables` at server
+#: Pre-lowered executables per (kernel, padded shape[, device]), keyed
+#: like ``_JIT_SEEN``.  Populated by :func:`prewarm_executables` at server
 #: startup (``[tpu] prewarm_quanta``) via ``jit(...).lower(...).compile()``;
 #: the dispatch wrappers consult it FIRST, so a warmed shape never pays an
 #: XLA trace at serving time and the flight recorder books its dispatches
-#: as cache hits (zero steady-state ``compile`` spans).
+#: as cache hits (zero steady-state ``compile`` spans).  Keys carry the
+#: compiling thread's :func:`device_scope` pin, so a per-lane prewarm
+#: yields one executable per chip and lane N's first dispatch finds ITS
+#: executable, not lane 0's.
 _AOT_CACHE: dict[tuple, object] = {}
 
 
 def _aot_get(*key):
+    key = _scoped_key(key)
     with _JIT_LOCK:
         return _AOT_CACHE.get(key)
 
 
 def _aot_register(key: tuple, exe) -> None:
+    key = _scoped_key(key)
     with _JIT_LOCK:
         _AOT_CACHE[key] = exe
         # pre-register the jit cache key: the first serving dispatch at
-        # this shape is a HIT (the compile happened before ready)
+        # this shape (on this device) is a HIT (compiled before ready)
         _JIT_SEEN.add(key)
 
 
@@ -267,29 +316,41 @@ def _prewarm_plan(batch_sizes) -> list[tuple]:
     return plan
 
 
-def prewarm_executables(batch_sizes) -> list[str]:
+def prewarm_executables(batch_sizes, devices=None) -> list[str]:
     """AOT-compile (``jit(...).lower(...).compile()``) the single-device
     verify kernels for every padded shape the given batch sizes dispatch,
     and register them in the AOT executable cache + ``_JIT_SEEN``.  Call
     before the server reports ready (``[tpu] prewarm_quanta``): steady-
-    state dispatch then never pays an XLA trace/compile.  Returns the
-    warmed shape keys (for the startup log).  Idempotent per shape."""
-    warmed: list[str] = []
-    for key, lower in _prewarm_plan(batch_sizes):
-        if _aot_get(*key) is not None:
-            continue
-        t0 = time.perf_counter()
-        exe = lower().compile()
-        _aot_register(key, exe)
-        name = "/".join(str(k) for k in key)
-        warmed.append(name)
-        log_s = time.perf_counter() - t0
-        if log_s > 1.0:  # long compiles are worth a line each
-            import logging
+    state dispatch then never pays an XLA trace/compile.
 
-            logging.getLogger("cpzk_tpu.ops.backend").info(
-                "prewarmed %s in %.1fs", name, log_s
-            )
+    ``devices`` targets the prewarm: ``None`` warms the default device
+    with the historical unsuffixed cache keys; a device list compiles one
+    executable PER device under :func:`device_scope`, so every per-device
+    dispatch lane's first serving dispatch books a jit HIT (before this,
+    prewarm registered ``_JIT_SEEN`` globally but compiled on the default
+    device only — lanes 1..N-1 ate a first-dispatch compile the recorder
+    then misbooked as a cache hit).
+
+    Returns the warmed shape keys (for the startup log).  Idempotent per
+    (shape, device)."""
+    warmed: list[str] = []
+    for device in (devices if devices is not None else [None]):
+        with device_scope(device):
+            for key, lower in _prewarm_plan(batch_sizes):
+                if _aot_get(*key) is not None:
+                    continue
+                t0 = time.perf_counter()
+                exe = lower().compile()
+                _aot_register(key, exe)
+                name = "/".join(str(k) for k in _scoped_key(key))
+                warmed.append(name)
+                log_s = time.perf_counter() - t0
+                if log_s > 1.0:  # long compiles are worth a line each
+                    import logging
+
+                    logging.getLogger("cpzk_tpu.ops.backend").info(
+                        "prewarmed %s in %.1fs", name, log_s
+                    )
     return warmed
 
 
@@ -325,17 +386,21 @@ def _points_soa(points: list[edwards.Point], pad: int) -> curve.Point:
     return curve.points_soa(points, pad)
 
 
-def _elems_soa(elems: list, pad: int) -> curve.Point:
+def _elems_soa(elems: list, pad: int, device=None) -> curve.Point:
     """SoA limb marshal of Elements.  Serving-path elements are
     wire-validated with lazy coordinates, so the native batch decode
     (threaded, ~9 us/point) beats materializing ``.point`` per element
     (~340 us of Python big-int decode each) by ~40x; falls back to the
     Python path when the native core is absent — checked FIRST, so the
-    fallback never pays O(n) wire encodes just to learn that."""
+    fallback never pays O(n) wire encodes just to learn that.  ``device``
+    targets the staging transfer at a pinned chip (per-lane dispatch);
+    the Python fallback relies on the caller's :func:`device_scope`."""
     from ..core import _native
 
     if _native.load() is not None:
-        dev = curve.wires_to_device(b"".join(e.wire() for e in elems), pad)
+        dev = curve.wires_to_device(
+            b"".join(e.wire() for e in elems), pad, device=device
+        )
         if dev is not None:
             return dev
     return _points_soa([e.point for e in elems], pad)
@@ -628,19 +693,34 @@ class TpuBackend(VerifierBackend):
     ``tpu.mesh_devices`` config knob); ``k > 1`` uses the first k.  The
     sharded paths ride ICI collectives via ``shard_map``
     (:mod:`cpzk_tpu.parallel.mesh`).
+
+    ``device`` pins every dispatch of THIS instance to one jax device
+    (staging transfers via ``jax.device_put``-targeted
+    ``wires_to_device``, jit/AOT execution via :func:`device_scope`) —
+    the per-device serving lanes each hold one pinned instance, so eight
+    chips serve eight independent batch streams.  Mutually exclusive
+    with a mesh.
     """
 
     prefers_combined = True
 
     def __init__(self, mesh_devices: int | None = None,
                  pippenger_min: int | None = None,
-                 gh_cache_max: int | None = None):
+                 gh_cache_max: int | None = None,
+                 device=None):
         """``pippenger_min`` overrides the rowcombined->Pippenger crossover
         for this instance (None = the module default / CPZK_PIPPENGER_MIN);
         a constructor parameter so callers (drivers, calibration sweeps)
         never need the env-plus-module-reload dance.  ``gh_cache_max``
         bounds the per-generator-pair device-point cache (None = the
-        GH_CACHE_MAX module default / CPZK_GH_CACHE_MAX)."""
+        GH_CACHE_MAX module default / CPZK_GH_CACHE_MAX).  ``device``
+        pins the instance to one jax device (see class docstring)."""
+        if device is not None and mesh_devices is not None:
+            raise ValueError(
+                "TpuBackend(device=...) pins one chip; it cannot also "
+                "shard over a mesh (mesh_devices must be None)"
+            )
+        self._device = device
         self._pippenger_min = (
             PIPPENGER_MIN_ROWS if pippenger_min is None else pippenger_min
         )
@@ -702,6 +782,10 @@ class TpuBackend(VerifierBackend):
     # -- VerifierBackend interface ------------------------------------------
 
     def verify_combined(self, rows: list[BatchRow], beta: Scalar) -> bool:
+        with device_scope(self._device):
+            return self._verify_combined(rows, beta)
+
+    def _verify_combined(self, rows: list[BatchRow], beta: Scalar) -> bool:
         n = len(rows)
         device_rlc = os.environ.get("CPZK_DEVICE_RLC") == "1"
 
@@ -716,10 +800,11 @@ class TpuBackend(VerifierBackend):
         t0 = time.perf_counter()
         pad = _pad_lanes(n + 1)
         _note_pad_waste(n + 1, pad)
-        r1 = _elems_soa([r.r1 for r in rows] + [rows[0].g], pad)
-        y1 = _elems_soa([r.y1 for r in rows] + [rows[0].h], pad)
-        r2 = _elems_soa([r.r2 for r in rows], pad)
-        y2 = _elems_soa([r.y2 for r in rows], pad)
+        dev = self._device
+        r1 = _elems_soa([r.r1 for r in rows] + [rows[0].g], pad, device=dev)
+        y1 = _elems_soa([r.y1 for r in rows] + [rows[0].h], pad, device=dev)
+        r2 = _elems_soa([r.r2 for r in rows], pad, device=dev)
+        y2 = _elems_soa([r.y2 for r in rows], pad, device=dev)
         if device_rlc:
             _jit_first_sight("rlc", pad)
             w_a, w_ac, w_ba, w_bac = _rlc_windows_device(rows, beta, pad)
@@ -785,7 +870,7 @@ class TpuBackend(VerifierBackend):
         # under one LANE_QUANTUM of identity terms
         m_pad = m if m <= LANE_CHUNK else _pad_lanes(m)
         _note_pad_waste(4 * len(rows) + 2, m_pad)
-        pts = _elems_soa(elems, m_pad)
+        pts = _elems_soa(elems, m_pad, device=self._device)
         if device_rlc:
             digits = _pippenger_digits_device(rows, beta, m_pad, c)
         else:
@@ -810,7 +895,12 @@ class TpuBackend(VerifierBackend):
         return chunked_msm_identity(c, pts, digits)
 
     def verify_each(self, rows: list[BatchRow]) -> list[bool]:
+        with device_scope(self._device):
+            return self._verify_each(rows)
+
+    def _verify_each(self, rows: list[BatchRow]) -> list[bool]:
         n = len(rows)
+        dev = self._device
         t0 = time.perf_counter()
         pad = _pad_lanes(n)
         _note_pad_waste(n, pad)
@@ -818,12 +908,12 @@ class TpuBackend(VerifierBackend):
         if shared:
             g, h = self._gh(rows[0])
         else:
-            g = _elems_soa([r.g for r in rows], pad)
-            h = _elems_soa([r.h for r in rows], pad)
-        y1 = _elems_soa([r.y1 for r in rows], pad)
-        y2 = _elems_soa([r.y2 for r in rows], pad)
-        r1 = _elems_soa([r.r1 for r in rows], pad)
-        r2 = _elems_soa([r.r2 for r in rows], pad)
+            g = _elems_soa([r.g for r in rows], pad, device=dev)
+            h = _elems_soa([r.h for r in rows], pad, device=dev)
+        y1 = _elems_soa([r.y1 for r in rows], pad, device=dev)
+        y2 = _elems_soa([r.y2 for r in rows], pad, device=dev)
+        r1 = _elems_soa([r.r1 for r in rows], pad, device=dev)
+        r2 = _elems_soa([r.r2 for r in rows], pad, device=dev)
         ws = _windows([r.s.value for r in rows], pad)
         wc = _windows([r.c.value for r in rows], pad)
         _note_marshal(t0)

@@ -86,16 +86,21 @@ def _staging_buffers(pad: int) -> tuple[np.ndarray, np.ndarray]:
     return bufs
 
 
-def wires_to_device(wires: bytes, pad: int) -> Point | None:
+def wires_to_device(wires: bytes, pad: int, device=None) -> Point | None:
     """n concatenated 32-byte wire encodings -> SoA limb arrays
     [20, pad] x 4, decoding on the native worker pool (~340 us/point of
     Python big-int decode avoided — the serving-path marshalling
     bottleneck) directly into the calling thread's reusable staging
     buffers (no per-batch coordinate-buffer allocation).  Identity-pads
-    to ``pad`` columns.  Returns None when the native core is unavailable
-    (caller falls back to the Python path); raises on an invalid encoding
-    (callers marshal elements that already passed parse-time validation,
-    so this is a can't-happen guard, not a validation layer)."""
+    to ``pad`` columns.  ``device`` targets the transfer at a specific
+    jax device (``jax.device_put``) — the per-device dispatch lanes pin
+    each lane's batches to its own chip; None keeps the default-device
+    behavior.  Staging buffers are per-THREAD, so each lane's persistent
+    device thread owns its own pair and lanes never contend.  Returns
+    None when the native core is unavailable (caller falls back to the
+    Python path); raises on an invalid encoding (callers marshal elements
+    that already passed parse-time validation, so this is a can't-happen
+    guard, not a validation layer)."""
     from ..core import _native
     from ..errors import InvalidGroupElement
 
@@ -109,6 +114,16 @@ def wires_to_device(wires: bytes, pad: int) -> Point | None:
         raise InvalidGroupElement("batch decode of pre-validated wire failed")
     # bytes_to_limbs materializes fresh limb arrays, so the staging rows
     # are free for reuse the moment this returns
+    if device is not None:
+        from jax import device_put
+
+        return tuple(
+            device_put(
+                limbs.bytes_to_limbs(np.ascontiguousarray(rows[:, k, :])),
+                device,
+            )
+            for k in range(4)
+        )
     return tuple(
         jnp.asarray(limbs.bytes_to_limbs(np.ascontiguousarray(rows[:, k, :])))
         for k in range(4)

@@ -21,6 +21,7 @@ __all__ = [
     "make_sharded_msm_check",
     "make_sharded_prove",
     "make_sharded_verify_each",
+    "resolve_lane_devices",
     "resolve_mesh_devices",
     "sharded_combined_check",
     "sharded_msm_check",

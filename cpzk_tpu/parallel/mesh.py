@@ -48,17 +48,50 @@ def batch_mesh(devices=None) -> Mesh:
 
 def resolve_mesh_devices(mesh_devices: int | None):
     """The shared ``mesh_devices`` convention: ``None`` -> no mesh
-    (single-device), ``0`` -> all visible devices, ``k`` -> the first
-    min(k, visible).  Returns a device list when a real (>1) mesh should
-    be built, else None — one policy for every mesh-capable component
-    (TpuBackend, BatchProver)."""
+    (single-device), ``0`` -> all visible devices, ``k`` -> the first k.
+    Returns a device list when a real (>1) mesh should be built, else
+    None — one policy for every mesh-capable component (TpuBackend,
+    BatchProver, the serving lane router).
+
+    Asking for more devices than exist is a deployment error, not a
+    preference: it used to clamp silently, so a config written for an
+    8-chip host "worked" on a 1-chip box at 1/8 the capacity with no
+    signal.  Rejected loudly instead."""
     if mesh_devices is None:
         return None
     n_avail = jax.device_count()
-    want = n_avail if mesh_devices == 0 else min(mesh_devices, n_avail)
+    if mesh_devices > n_avail:
+        raise ValueError(
+            f"mesh_devices={mesh_devices} exceeds the {n_avail} visible "
+            f"jax device(s) on this host — fix the topology knob or the "
+            "deployment (a silent clamp would serve at a fraction of the "
+            "configured capacity)"
+        )
+    want = n_avail if mesh_devices == 0 else mesh_devices
     if want <= 1:
         return None
     return jax.devices()[:want]
+
+
+def resolve_lane_devices(lanes: int):
+    """Lane-count discovery for the per-device serving plane (``[tpu]
+    lanes``): ``1`` -> None (the single-lane fast path, today's
+    behavior), ``-1`` -> one lane per local device, ``k > 1`` -> the
+    first k local devices (rejected when k exceeds the local count, same
+    policy as :func:`resolve_mesh_devices`).  Returns a device list only
+    when a real multi-lane router should be built."""
+    if lanes == 1:
+        return None
+    if lanes == -1:
+        devices = jax.local_devices()
+        return devices if len(devices) > 1 else None
+    n_local = jax.local_device_count()
+    if lanes > n_local:
+        raise ValueError(
+            f"lanes={lanes} exceeds the {n_local} local jax device(s) on "
+            "this host — one dispatch lane pins one local chip"
+        )
+    return jax.local_devices()[:lanes]
 
 
 def pad_to_multiple(pt: curve.Point, n_to: int) -> curve.Point:

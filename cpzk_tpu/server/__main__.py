@@ -81,12 +81,20 @@ def build_backend(config):
     reference-parity inline CPU path.  With ``[tpu] prewarm_quanta`` set,
     the verify kernels for those batch sizes are AOT-compiled HERE — before
     the listener binds and health reports ready — so the first serving
-    dispatch at a warmed shape never pays an XLA trace."""
+    dispatch at a warmed shape never pays an XLA trace.
+
+    ``[tpu] lanes != 1`` builds the multi-chip serving plane instead: one
+    per-device ``DispatchLane`` per local device behind a deadline-aware
+    :class:`~cpzk_tpu.server.router.LaneRouter` with a per-lane breaker
+    (one sick chip degrades only its lane), per-device AOT prewarm, and —
+    with ``mesh_threshold`` set — a big-batch mesh lane riding the
+    sharded kernels (docs/operations.md §"Multi-chip serving")."""
     if config.tpu.backend != "tpu":
         return None, None
     import jax
 
     from ..ops.backend import TpuBackend, enable_donation, prewarm_executables
+    from ..parallel import resolve_lane_devices
     from ..protocol.batch import CpuBackend, FailoverBackend
     from .batching import DynamicBatcher
 
@@ -95,6 +103,59 @@ def build_backend(config):
     # XLA CPU ignores donation and warns per call, so gate it off there
     enable_donation(jax.default_backend() != "cpu")
 
+    quanta = config.tpu.parsed_prewarm_quanta()
+    recovery_after_s = (
+        None if config.tpu.recovery_after_s == -1
+        else config.tpu.recovery_after_s
+    )
+    lane_devices = resolve_lane_devices(config.tpu.lanes)
+    if lane_devices is not None:
+        from .router import LaneRouter
+
+        lane_backends = [TpuBackend(device=d) for d in lane_devices]
+        if quanta:
+            t0 = time.monotonic()
+            warmed = prewarm_executables(quanta, devices=lane_devices)
+            log.info(
+                "prewarmed %d verify executables for batch quanta %s "
+                "across %d devices in %.1fs", len(warmed), quanta,
+                len(lane_devices), time.monotonic() - t0,
+            )
+        mesh_backend = None
+        if config.tpu.mesh_threshold > 0:
+            mesh_backend = TpuBackend(mesh_devices=len(lane_devices))
+        router = LaneRouter(
+            lane_backends,
+            devices=lane_devices,
+            overlap=config.tpu.pipeline_depth > 1,
+            staging_slots=max(1, config.tpu.pipeline_depth - 1),
+            recovery_after_s=recovery_after_s,
+            mesh_backend=mesh_backend,
+            mesh_threshold=config.tpu.mesh_threshold,
+        )
+        # the resolved topology, surfaced once at boot: lane count +
+        # device list + mesh crossover (and the tpu.lanes gauge for
+        # dashboards that can't read logs)
+        metrics.gauge("tpu.lanes").set(len(lane_devices))
+        log.info(
+            "serving plane: %d per-device dispatch lanes over %s (of %d "
+            "local / %d visible devices), mesh path %s",
+            len(lane_devices),
+            ", ".join(str(d) for d in lane_devices),
+            jax.local_device_count(), jax.device_count(),
+            f"at >= {config.tpu.mesh_threshold} entries"
+            if config.tpu.mesh_threshold > 0 else "off",
+        )
+        batcher = DynamicBatcher(
+            lane_backends[0],
+            max_batch=config.tpu.batch_max,
+            window_ms=config.tpu.batch_window_ms,
+            pipeline_depth=config.tpu.pipeline_depth,
+            shed_expired=config.tpu.shed_expired,
+            router=router,
+        )
+        return lane_backends[0], batcher
+
     # mesh_devices semantics: 0 = shard over all visible devices (default),
     # k = first k devices; TpuBackend skips the mesh when only 1 is visible.
     # recovery_after_s = -1 disables the breaker's self-healing (degrade
@@ -102,13 +163,9 @@ def build_backend(config):
     backend = FailoverBackend(
         TpuBackend(mesh_devices=config.tpu.mesh_devices),
         CpuBackend(),
-        recovery_after_s=(
-            None if config.tpu.recovery_after_s == -1
-            else config.tpu.recovery_after_s
-        ),
+        recovery_after_s=recovery_after_s,
         probe_batch_max=config.tpu.probe_batch_max,
     )
-    quanta = config.tpu.parsed_prewarm_quanta()
     if quanta:
         t0 = time.monotonic()
         warmed = prewarm_executables(quanta)
@@ -117,6 +174,13 @@ def build_backend(config):
             "(%s)", len(warmed), quanta, time.monotonic() - t0,
             ", ".join(warmed) or "all cached",
         )
+    metrics.gauge("tpu.lanes").set(1)
+    log.info(
+        "serving plane: single dispatch lane (%d local / %d visible "
+        "devices; mesh_devices=%d for in-batch sharding)",
+        jax.local_device_count(), jax.device_count(),
+        config.tpu.mesh_devices,
+    )
     batcher = DynamicBatcher(
         backend,
         max_batch=config.tpu.batch_max,

@@ -62,6 +62,18 @@ class TpuSettings:
     batch_max: int = 4096         # dynamic-batcher device batch target
     batch_window_ms: float = 5.0  # queue deadline before dispatch
     mesh_devices: int = 0         # 0 = all visible devices
+    lanes: int = 1                # per-device dispatch lanes behind the
+                                  # LaneRouter: 1 = single-lane (today's
+                                  # path, structurally unchanged),
+                                  # -1 = one lane per local device,
+                                  # k > 1 = the first k local devices
+    mesh_threshold: int = 0       # entries at/above which a settled batch
+                                  # routes to the mesh lane (one sharded
+                                  # program over all lane devices) instead
+                                  # of one per-device lane; 0 = never —
+                                  # the crossover is silicon-specific, so
+                                  # it ships as a measured knob, not a
+                                  # guess
     pipeline_depth: int = 2       # in-flight batches (1 = serial dispatch);
                                   # >1 double-buffers host prep against
                                   # device compute on the dispatch lane
@@ -465,6 +477,10 @@ class ServerConfig:
             self.tpu.batch_window_ms = float(v)
         if (v := get("TPU_MESH_DEVICES")) is not None:
             self.tpu.mesh_devices = int(v)
+        if (v := get("TPU_LANES")) is not None:
+            self.tpu.lanes = int(v)
+        if (v := get("TPU_MESH_THRESHOLD")) is not None:
+            self.tpu.mesh_threshold = int(v)
         if (v := get("TPU_PIPELINE_DEPTH")) is not None:
             self.tpu.pipeline_depth = int(v)
         if (v := get("TPU_RECOVERY_AFTER_S")) is not None:
@@ -641,6 +657,21 @@ class ServerConfig:
             raise ValueError("tpu.batch_window_ms cannot be negative")
         if self.tpu.mesh_devices < 0:
             raise ValueError("tpu.mesh_devices cannot be negative")
+        if self.tpu.lanes == 0 or self.tpu.lanes < -1:
+            raise ValueError(
+                "tpu.lanes must be a positive lane count, or -1 for one "
+                "lane per local device"
+            )
+        if self.tpu.mesh_threshold < 0:
+            raise ValueError(
+                "tpu.mesh_threshold cannot be negative (0 disables the "
+                "big-batch mesh path)"
+            )
+        if self.tpu.mesh_threshold > 0 and self.tpu.lanes == 1:
+            raise ValueError(
+                "tpu.mesh_threshold needs tpu.lanes != 1 (the mesh lane "
+                "shards over the per-device lanes' devices)"
+            )
         if self.tpu.recovery_after_s < 0 and self.tpu.recovery_after_s != -1:
             raise ValueError(
                 "tpu.recovery_after_s must be >= 0, or -1 to disable self-healing"
