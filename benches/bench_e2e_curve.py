@@ -113,7 +113,8 @@ def build_serving_plane(backend_name: str, lanes: int, quantum: int):
 
 
 async def grpc_curve_point(
-    n: int, provers, rng, backend_name: str, lanes: int = 1
+    n: int, provers, rng, backend_name: str, lanes: int = 1,
+    wire: str = "native",
 ) -> tuple[float, float, float]:
     """(serial_pps, pipelined_pps, stream_pps): wall time of the timed
     verify RPCs for n proofs with one RPC in flight, then with each
@@ -153,7 +154,7 @@ async def grpc_curve_point(
         fleet = FleetRouter(PartitionMap.uniform(["127.0.0.1:0"]), 0)
     server, port = await serve(
         state, RateLimiter(10**9, 10**9), host="127.0.0.1", port=0,
-        backend=backend, batcher=batcher, fleet=fleet,
+        backend=backend, batcher=batcher, fleet=fleet, wire=wire,
     )
     # CPZK_BENCH_OPSPLANE=1: run the full HTTP introspection server +
     # SLO engine alongside the timed passes — the perf gate's proof that
@@ -324,6 +325,17 @@ def main() -> None:
                          "device_count=8).  Entries carry the lane "
                          "count as a perf-gate config key, so a new "
                          "lane count seeds its own trajectory")
+    ap.add_argument("--wire", default="native",
+                    choices=["native", "python"],
+                    help="transport wire path for the serving passes: "
+                         "native = the C++ request parser straight off "
+                         "the socket bytes (with protobuf fallback), "
+                         "python = the protobuf runtime only (the "
+                         "historical baseline).  Serving entries carry "
+                         "the mode as a perf-gate config key (old "
+                         "baselines load as wire=python; a new mode "
+                         "seeds its own trajectory); the direct entries "
+                         "never touch a wire and keep the python key")
     ap.add_argument("--snapshot", default=None,
                     help="also write a cpzk-perf-snapshot JSON here "
                          "(throughput per n + flight-recorder stage "
@@ -371,7 +383,7 @@ def main() -> None:
         direct = direct_curve_point(n, provers, rng, params, args.backend)
         grpc_pps, grpc_pipelined, stream_pps = asyncio.run(
             grpc_curve_point(n, provers, rng, args.backend,
-                             lanes=args.lanes))
+                             lanes=args.lanes, wire=args.wire))
         resolved_lanes = args.lanes
         if args.lanes == -1:
             # report the resolved count, not the sentinel
@@ -383,6 +395,7 @@ def main() -> None:
             "metric": "e2e_curve",
             "n": n,
             "lanes": resolved_lanes,
+            "wire": args.wire,
             "grpc_pps": round(grpc_pps, 1),
             "grpc_pipelined_pps": round(grpc_pipelined, 1),
             "stream_pps": round(stream_pps, 1),
@@ -403,6 +416,10 @@ def main() -> None:
                 name=name, backend=args.backend, n=n,
                 value=round(pps, 2), unit="proofs/s",
                 lanes=resolved_lanes,
+                # direct never touches a wire: it keeps the python key
+                # so it gates against the historical baseline on every
+                # run regardless of --wire
+                wire=args.wire if name != "e2e_curve.direct" else "python",
                 stages_ms=stages if name.startswith("e2e_curve.grpc") else {},
             ))
 

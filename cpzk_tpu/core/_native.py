@@ -9,6 +9,7 @@ accelerator, never a requirement (SURVEY.md §2.2 rebuild strategy).
 from __future__ import annotations
 
 import ctypes
+import logging
 import os
 import subprocess
 
@@ -18,6 +19,34 @@ _SRC_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__
 
 _lib: ctypes.CDLL | None = None
 _tried = False
+_build_warned = False
+
+log = logging.getLogger("cpzk_tpu.core.native")
+
+
+def _warn_build_failure(exc: Exception) -> None:
+    """One-time WARNING when the native build fails: before this, every
+    failure was swallowed silently and a box with a broken toolchain was
+    indistinguishable from a deliberate ``CPZK_NO_NATIVE_BUILD=1`` — the
+    operator had no signal they were serving on the pure-Python slow
+    path.  The compiler/make stderr rides in the message, so the root
+    cause (missing g++, bad flags, read-only tree) is in the log line
+    itself, not on a box someone has to ssh into."""
+    global _build_warned
+    if _build_warned:
+        return
+    _build_warned = True
+    detail = str(exc)
+    stderr = getattr(exc, "stderr", None)
+    if stderr:
+        if isinstance(stderr, bytes):
+            stderr = stderr.decode("utf-8", "replace")
+        detail = f"{exc}: {stderr.strip()}"
+    log.warning(
+        "native core build failed — falling back to the pure-Python slow "
+        "path (set CPZK_NO_NATIVE_BUILD=1 to silence this if intentional). "
+        "make -C %s said: %s", _SRC_DIR, detail,
+    )
 
 
 def _build(force: bool = False) -> bool:
@@ -32,7 +61,8 @@ def _build(force: bool = False) -> bool:
             timeout=120,
         )
         return os.path.exists(_LIB_PATH)
-    except Exception:
+    except Exception as exc:
+        _warn_build_failure(exc)
         return False
 
 
@@ -93,6 +123,24 @@ def _declare(lib: ctypes.CDLL) -> None:
         lib.cpzk_point_add.argtypes = [
             ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p,
         ]
+    if hasattr(lib, "cpzk_wire_scan"):
+        lib.cpzk_wire_scan.restype = ctypes.c_int
+        lib.cpzk_wire_scan.argtypes = [
+            ctypes.c_int, ctypes.c_char_p, ctypes.c_size_t,
+            ctypes.POINTER(ctypes.c_size_t),
+        ]
+        lib.cpzk_wire_fill.restype = ctypes.c_int
+        lib.cpzk_wire_fill.argtypes = (
+            [ctypes.c_int, ctypes.c_char_p, ctypes.c_size_t]
+            + [ctypes.POINTER(ctypes.c_uint64)] * 7
+            + [ctypes.c_char_p]
+        )
+        lib.cpzk_wire_gather.restype = ctypes.c_size_t
+        lib.cpzk_wire_gather.argtypes = [
+            ctypes.c_char_p, ctypes.c_size_t,
+            ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(ctypes.c_uint64),
+            ctypes.c_size_t, ctypes.c_char_p,
+        ]
     if hasattr(lib, "cpzk_double_basemul"):
         lib.cpzk_basemul_init.restype = ctypes.c_int
         lib.cpzk_basemul_init.argtypes = [ctypes.c_char_p, ctypes.c_char_p]
@@ -107,7 +155,7 @@ def _declare(lib: ctypes.CDLL) -> None:
 # force-rebuilds once on mismatch — keyed on an explicit generation number
 # rather than symbol presence, because a changed signature or changed
 # semantics behind an existing symbol is invisible to hasattr.
-_ABI_EXPECTED = 2
+_ABI_EXPECTED = 3
 
 
 def _abi(lib: ctypes.CDLL) -> int:
@@ -389,6 +437,75 @@ def point_add(a: bytes, b: bytes) -> bytes | None:
     if not lib.cpzk_point_add(a, b, out):
         return b""
     return out.raw
+
+
+# --- native request-wire parse (native/wire.cpp) ---------------------------
+
+#: Message kinds, mirroring the enum in native/wire.cpp.
+WIRE_CHALLENGE = 1       # auth.ChallengeRequest
+WIRE_BATCH_VERIFY = 2    # auth.BatchVerificationRequest
+WIRE_STREAM_CHUNK = 3    # auth.StreamVerifyRequest
+
+
+def wire_lib():
+    """The library iff it exports the wire parser; None otherwise."""
+    lib = load()
+    if lib is None or not hasattr(lib, "cpzk_wire_scan"):
+        return None
+    return lib
+
+
+def wire_index(kind: int, data: bytes):
+    """Index one request message's known fields natively.
+
+    Returns ``(counts, offs, lens, vals, mint)`` — per-bucket counts
+    ``(n0, n1, n2, n_vals)``, per-bucket ctypes uint64 offset/length
+    arrays into ``data``, the decoded uint64 ``ids`` values, and the
+    final ``mint_sessions`` bool — or ``None`` when the library is
+    absent or the message is outside the parser's recognized subset
+    (the caller then falls back to the Python protobuf runtime, which
+    makes accept/reject and field values identical by construction)."""
+    lib = wire_lib()
+    if lib is None:
+        return None
+    counts = (ctypes.c_size_t * 4)()
+    if not lib.cpzk_wire_scan(kind, data, len(data), counts):
+        return None
+    n0, n1, n2, nv = counts[0], counts[1], counts[2], counts[3]
+    offs = tuple((ctypes.c_uint64 * max(n, 1))() for n in (n0, n1, n2))
+    lens = tuple((ctypes.c_uint64 * max(n, 1))() for n in (n0, n1, n2))
+    vals = (ctypes.c_uint64 * max(nv, 1))()
+    flags = ctypes.create_string_buffer(1)
+    if not lib.cpzk_wire_fill(
+        kind, data, len(data),
+        offs[0], lens[0], offs[1], lens[1], offs[2], lens[2], vals, flags,
+    ):
+        return None  # unreachable in practice: same walk as the scan
+    return (n0, n1, n2, nv), offs, lens, vals, flags.raw[0:1] == b"\x01"
+
+
+def wire_gather(data: bytes, offs, lens, n: int, total: int, out=None):
+    """Concatenate ``n`` (offset, length) ranges of ``data`` into ``out``
+    (a writable buffer of >= ``total`` bytes — the per-thread staging
+    buffer on the hot path) or a fresh bytes object when ``out`` is
+    None.  Returns the buffer written (``out`` itself, or the new
+    bytes); None when the library is unavailable."""
+    lib = wire_lib()
+    if lib is None:
+        return None
+    if out is None:
+        buf = ctypes.create_string_buffer(total)
+        written = lib.cpzk_wire_gather(data, len(data), offs, lens, n, buf)
+        if written != total:
+            raise ValueError("wire gather ranges out of bounds")
+        return buf.raw
+    if len(out) < total:
+        raise ValueError("staging buffer too small for the gathered ranges")
+    cbuf = (ctypes.c_char * len(out)).from_buffer(out)
+    written = lib.cpzk_wire_gather(data, len(data), offs, lens, n, cbuf)
+    if written != total:
+        raise ValueError("wire gather ranges out of bounds")
+    return out
 
 
 class NativeMerlin:

@@ -1356,7 +1356,8 @@ int cpzk_batch_decode(size_t n, const uint8_t *wires, uint8_t *coords,
 // exported-signature or exported-semantics change (not just new symbols —
 // a symbol-presence check cannot see a changed signature).
 // 2: cpzk_parse_proofs gained `deep`; cpzk_verify_rows out[] went tri-state.
-int cpzk_abi_version(void) { return 2; }
+// 3: wire.cpp added cpzk_wire_scan/fill/gather (native request parse).
+int cpzk_abi_version(void) { return 3; }
 
 // --- small self-check helpers exposed for differential tests ---------------
 

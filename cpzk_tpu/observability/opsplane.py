@@ -80,6 +80,7 @@ class OpsSources:
     service: object | None = None      # AuthServiceImpl (stream stats)
     slo: object | None = None          # SloEngine
     fleet: object | None = None        # fleet.FleetRouter
+    ingest: object | None = None       # server.ingest.IngestSupervisor
     config_fingerprint: str = ""
     role: str = "server"               # "server" | "standby" | "audit"
     started_at: float = field(default_factory=time.monotonic)
@@ -195,6 +196,12 @@ class OpsSources:
         # has answered (map version/digest spot drift across the fleet)
         fleet = self.fleet
         doc["fleet"] = fleet.status() if fleet is not None else None
+
+        # sharded ingest: one row per SO_REUSEPORT listener process
+        # (pid, connected, rpcs/streams handled, native parses vs
+        # protobuf fallbacks, respawns); null on in-process listeners
+        ingest = self.ingest
+        doc["ingest"] = ingest.status() if ingest is not None else None
 
         durability = self.durability
         if durability is not None and getattr(durability, "wal", None) is not None:

@@ -49,12 +49,15 @@ PERCENTILES = (50, 90, 99)
 class PerfEntry:
     """One measured configuration of one benchmark.
 
-    ``lanes`` is a config-key component (the multi-chip serving plane's
-    dispatch-lane count): entries measured at different lane counts gate
-    independently, and because absent keys never gate, the first
-    snapshot carrying a new lane count seeds its trajectory instead of
-    failing CI.  Baselines written before the key existed load as
-    ``lanes=1`` — exactly the configuration they measured."""
+    ``lanes`` and ``wire`` are config-key components (the multi-chip
+    serving plane's dispatch-lane count; the transport wire path,
+    ``"python"`` = protobuf runtime, ``"native"`` = the C++ wire
+    parser): entries measured at different values gate independently,
+    and because absent keys never gate, the first snapshot carrying a
+    new lane count or wire mode seeds its trajectory instead of failing
+    CI.  Baselines written before a key existed load with its historical
+    value (``lanes=1``, ``wire="python"``) — exactly the configuration
+    they measured."""
 
     name: str
     backend: str
@@ -63,10 +66,12 @@ class PerfEntry:
     unit: str
     spread: float = 0.0  # max-min over repeat runs, same unit as value
     lanes: int = 1
+    wire: str = "python"
     stages_ms: dict[str, dict[str, float]] = field(default_factory=dict)
 
-    def key(self) -> tuple[str, str, int, str, int]:
-        return (self.name, self.backend, self.n, self.unit, self.lanes)
+    def key(self) -> tuple[str, str, int, str, int, str]:
+        return (self.name, self.backend, self.n, self.unit, self.lanes,
+                self.wire)
 
     def to_dict(self) -> dict:
         out = {
@@ -79,6 +84,8 @@ class PerfEntry:
         }
         if self.lanes != 1:
             out["lanes"] = self.lanes
+        if self.wire != "python":
+            out["wire"] = self.wire
         if self.stages_ms:
             out["stages_ms"] = self.stages_ms
         return out
@@ -93,6 +100,7 @@ class PerfEntry:
             unit=str(data.get("unit", "ms/batch")),
             spread=max(0.0, float(data.get("spread", 0.0))),
             lanes=int(data.get("lanes", 1)),
+            wire=str(data.get("wire", "python")),
             stages_ms=dict(data.get("stages_ms", {})),
         )
 
@@ -155,7 +163,7 @@ def stage_percentiles(
 class Delta:
     """One compared entry: relative change, adjusted gate, verdict."""
 
-    key: tuple[str, str, int, str, int]
+    key: tuple[str, str, int, str, int, str]
     old: float
     new: float
     change: float      # relative move in the BAD direction (>0 = worse)
@@ -163,11 +171,12 @@ class Delta:
     regressed: bool
 
     def describe(self) -> str:
-        name, backend, n, unit, lanes = self.key
+        name, backend, n, unit, lanes, wire = self.key
         lane_tag = f"/lanes={lanes}" if lanes != 1 else ""
+        wire_tag = f"/wire={wire}" if wire != "python" else ""
         arrow = "WORSE" if self.change > 0 else "better"
         return (
-            f"{name}/{backend}/n={n}{lane_tag}: "
+            f"{name}/{backend}/n={n}{lane_tag}{wire_tag}: "
             f"{self.old:g} -> {self.new:g} {unit} "
             f"({abs(self.change) * 100:.1f}% {arrow}, "
             f"gate {self.limit * 100:.1f}%)"

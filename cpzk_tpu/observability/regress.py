@@ -57,6 +57,7 @@ def main(argv: list[str] | None = None) -> int:
                     {
                         "name": d.key[0], "backend": d.key[1],
                         "n": d.key[2], "unit": d.key[3],
+                        "lanes": d.key[4], "wire": d.key[5],
                         "old": d.old, "new": d.new,
                         "change": d.change, "limit": d.limit,
                     }

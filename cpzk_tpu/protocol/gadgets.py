@@ -204,7 +204,9 @@ class Proof:
 
     @staticmethod
     def from_bytes_batch(
-        items: "list[bytes]", defer_point_validation: bool = False
+        items: "list[bytes]",
+        defer_point_validation: bool = False,
+        packed: bytes | None = None,
     ) -> "list[Proof | Error]":
         """Parse n proof wires with ONE native validation call for the
         whole batch (``cpzk_parse_proofs`` worker pool) instead of per-item
@@ -218,12 +220,26 @@ class Proof:
         ``defer_point_validation=True`` skips the two commitment point
         decodes here and returns ``deferred`` proofs (see :class:`Proof`);
         only hand those to a :class:`~cpzk_tpu.protocol.batch.BatchVerifier`,
-        which settles the postponed decodes with exact error parity."""
+        which settles the postponed decodes with exact error parity.
+
+        ``packed``, when provided, MUST be the concatenation of ``items``
+        with every item at the canonical ``PROOF_WIRE_SIZE`` — the native
+        wire path's C-gathered staging buffer.  The batched native
+        validation then runs over it directly, skipping the per-item
+        ``bytes()`` + join this method otherwise pays (zero copies
+        between the socket bytes and the parse pass).  Results are
+        identical either way; a mismatched length falls back to the
+        normal path."""
         n = len(items)
         results: list = [None] * n
-        sized = [i for i in range(n) if len(items[i]) == PROOF_WIRE_SIZE]
+        if packed is not None and n and len(packed) == PROOF_WIRE_SIZE * n:
+            sized = range(n)
+        else:
+            packed = None
+            sized = [i for i in range(n) if len(items[i]) == PROOF_WIRE_SIZE]
         if sized:
-            packed = b"".join(bytes(items[i]) for i in sized)
+            if packed is None:
+                packed = b"".join(bytes(items[i]) for i in sized)
             flags = _native.parse_proofs(packed, deep=not defer_point_validation)
             if flags is not None:
                 build = (Proof._from_framed_wire if defer_point_validation

@@ -777,14 +777,34 @@ async def amain(args) -> None:
         )
         await metrics_fallback_plane.start()
 
+    # sharded ingest ([server] ingest_shards > 1): the dispatch process
+    # starts PORTLESS and N SO_REUSEPORT listener processes own the
+    # public address, feeding it over the CRC-framed unix-socket seam;
+    # ingest_shards = 1 binds in-process — today's path, structurally
+    # unchanged (no supervisor is ever constructed)
+    shard_ingest = config.server.ingest_shards > 1
     server, port = await serve(
         state, limiter, host=config.host, port=config.port,
         backend=backend, batcher=batcher, tls=tls, admission=admission,
         replica=replica, audit_log=audit_log,
         stream_window=config.tpu.stream_window,
         stream_entry_deadline_ms=config.tpu.stream_entry_deadline_ms,
-        fleet=fleet_router,
+        fleet=fleet_router, wire=config.server.wire,
+        listen=not shard_ingest,
     )
+    ingest = None
+    if shard_ingest:
+        from .ingest import IngestSupervisor
+
+        ingest = IngestSupervisor(
+            server.auth_service, server.health,
+            shards=config.server.ingest_shards,
+            host=config.host, port=config.port,
+            wire=config.server.wire, tls=tls,
+        )
+        await ingest.start()
+        port = config.port
+        ops_sources.ingest = ingest
     # late attachments: serve() built these (health gate, stream registry)
     ops_sources.health = server.health
     ops_sources.service = server.auth_service
@@ -792,7 +812,15 @@ async def amain(args) -> None:
         shipper.start()
     if replica is not None:
         replica.start()
-    print(_c("green", f"AuthService listening on {config.host}:{port}"))
+    from .wire import native_available
+
+    log.info(
+        "wire path: %s (native parser %savailable)", config.server.wire,
+        "" if native_available() else "NOT ",
+    )
+    print(_c("green", f"AuthService listening on {config.host}:{port}"
+             + (f" ({config.server.ingest_shards} ingest shards)"
+                if shard_ingest else "")))
 
     loop = asyncio.get_running_loop()
     for sig in (signal.SIGINT, signal.SIGTERM):
@@ -847,6 +875,8 @@ async def amain(args) -> None:
     print(_c("yellow", "shutdown: flipping health to NOT_SERVING, draining..."))
     server.health.serving = False
     await asyncio.sleep(DRAIN_SECONDS)
+    if ingest is not None:
+        await ingest.stop()  # listener shards down before the batcher drain
     if batcher is not None:
         await batcher.stop()  # drain queued verifications before the listener
     if audit_log is not None:

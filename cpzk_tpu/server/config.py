@@ -23,6 +23,29 @@ from dataclasses import dataclass, field
 
 
 @dataclass
+class ServerSettings:
+    """Transport-plane knobs (``[server]``): the native wire path and the
+    SO_REUSEPORT sharded-ingest mode.  See ``docs/operations.md``
+    §"Wire path & ingest shards"."""
+
+    wire: str = "native"   # "native" = hand-rolled C++ parse of the hot
+                           # request messages straight off the socket
+                           # bytes (unconditional fallback to the Python
+                           # protobuf runtime when the .so is absent or a
+                           # message is outside the parser's recognized
+                           # subset) | "python" = always the protobuf
+                           # runtime (today's path)
+    ingest_shards: int = 1  # 1 = in-process listener (today's path,
+                            # structurally unchanged); N > 1 = N forked
+                            # event-loop processes each bind the listener
+                            # via SO_REUSEPORT and run admission + native
+                            # parse, feeding this dispatch/state process
+                            # over a CRC-framed unix-socket seam — ingest
+                            # scales with host cores the way the device
+                            # plane scales with chips
+
+
+@dataclass
 class RateLimitSettings:
     """The GLOBAL token bucket — the aggregate backstop behind the
     per-client buckets in ``[admission]``.  ``requests_per_minute`` has
@@ -329,6 +352,7 @@ class ServerConfig:
     port: int = 50051
     # opt-in checkpoint/resume (empty = in-memory only, reference parity)
     state_file: str = ""
+    server: ServerSettings = field(default_factory=ServerSettings)
     rate_limit: RateLimitSettings = field(default_factory=RateLimitSettings)
     admission: AdmissionSettings = field(default_factory=AdmissionSettings)
     metrics: MetricsSettings = field(default_factory=MetricsSettings)
@@ -383,6 +407,7 @@ class ServerConfig:
         if "state_file" in data:
             self.state_file = str(data["state_file"])
         for section, obj in (
+            ("server", self.server),
             ("rate_limit", self.rate_limit),
             ("admission", self.admission),
             ("metrics", self.metrics),
@@ -427,6 +452,11 @@ class ServerConfig:
             self.port = int(v)
         if (v := get("STATE_FILE")) is not None:
             self.state_file = v
+        # transport-plane knobs (native wire path + sharded ingest)
+        if (v := get("WIRE")) is not None:
+            self.server.wire = v.lower()
+        if (v := get("INGEST_SHARDS")) is not None:
+            self.server.ingest_shards = int(v)
         # short aliases mirror the reference's clap env names
         if (v := get_alias("RATE_LIMIT_REQUESTS_PER_MINUTE", "RATE_LIMIT")) is not None:
             self.rate_limit.requests_per_minute = int(v)
@@ -646,6 +676,26 @@ class ServerConfig:
             raise ValueError(
                 "admission retry_after bounds must satisfy "
                 "0 <= retry_after_min_ms <= retry_after_max_ms"
+            )
+        if self.server.wire not in ("native", "python"):
+            raise ValueError(
+                "server.wire must be 'native' (C++ request parse with "
+                "Python fallback) or 'python' (protobuf runtime only)"
+            )
+        if not 1 <= self.server.ingest_shards <= 64:
+            raise ValueError(
+                "server.ingest_shards must be in [1, 64] (1 = the "
+                "in-process listener)"
+            )
+        if (
+            self.server.ingest_shards > 1
+            and self.replication.enabled
+            and self.replication.role == "standby"
+        ):
+            raise ValueError(
+                "server.ingest_shards > 1 requires replication.role = "
+                "'primary': ingest shards proxy only auth + health, and "
+                "a standby must receive ShipSegment on its own listener"
             )
         if self.tpu.backend not in ("cpu", "tpu"):
             raise ValueError(f"Unknown verifier backend: {self.tpu.backend}")

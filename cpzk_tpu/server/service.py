@@ -30,6 +30,7 @@ from ..protocol.batch import BatchEntry, BatchVerifier, VerifierBackend
 from ..protocol.gadgets import Parameters, Proof, Statement
 from ..protocol.verifier import Verifier
 from . import batching, metrics
+from . import wire as wire_mod
 from .config import RateLimiter, RateLimitExceeded
 from .dispatch import DispatchLane
 from .proto import SERVICE_NAME, load_pb2, method_types, stream_method_types
@@ -70,9 +71,14 @@ class AuthServiceImpl:
         stream_window: int = 8192,
         stream_entry_deadline_ms: float = 0.0,
         fleet=None,
+        wire: str = "native",
     ):
         self.state = state
         self.rate_limiter = rate_limiter
+        #: transport wire mode: "native" = C++ request parse with
+        #: unconditional Python-protobuf fallback, "python" = protobuf
+        #: runtime only (see server/wire.py; [server] wire knob)
+        self.wire = wire
         self.backend = backend
         self.batcher = batcher  # DynamicBatcher | None (TPU serving path)
         self.admission = admission  # AdmissionController | None
@@ -248,6 +254,15 @@ class AuthServiceImpl:
             await context.abort(grpc.StatusCode.FAILED_PRECONDITION, msg)
 
     @staticmethod
+    def _note_wire(request) -> None:
+        """wire_parse trace span for natively-parsed requests (no-op on
+        the protobuf path and outside an instrumented RPC)."""
+        rctx = current_context.get()
+        wire_mod.note_wire_parse(
+            request, rctx.trace_id if rctx is not None else None
+        )
+
+    @staticmethod
     def _request_context(context):
         """The decorator-minted :class:`RequestContext` of this RPC (trace
         id + absolute deadline), or a fresh one when the handler was
@@ -414,6 +429,7 @@ class AuthServiceImpl:
 
     @traced_rpc("CreateChallenge", "auth.challenge")
     async def create_challenge(self, request, context):
+        self._note_wire(request)
         await self._admit(context, "CreateChallenge")
         await self._validate_user_id(request.user_id, context)
         await self._check_owner(request.user_id, context)
@@ -526,6 +542,7 @@ class AuthServiceImpl:
 
     @traced_rpc("VerifyProofBatch", "auth.verify_batch")
     async def verify_proof_batch(self, request, context):
+        self._note_wire(request)
         await self._admit(context, "VerifyProofBatch")
 
         n = len(request.user_ids)
@@ -543,10 +560,17 @@ class AuthServiceImpl:
         metrics.counter("auth.verify_batch.proofs_count").inc(n)
 
         # materialize the repeated fields once: protobuf repeated-field
-        # __getitem__ costs add up over 3 accesses x 1000 items
-        user_ids = list(request.user_ids)
-        challenge_ids = list(request.challenge_ids)
-        proof_wires = list(request.proofs)
+        # __getitem__ costs add up over 3 accesses x 1000 items (the
+        # native wire views already hold plain lists — no copy needed)
+        user_ids = request.user_ids
+        if type(user_ids) is not list:
+            user_ids = list(user_ids)
+        challenge_ids = request.challenge_ids
+        if type(challenge_ids) is not list:
+            challenge_ids = list(challenge_ids)
+        proof_wires = request.proofs
+        if type(proof_wires) is not list:
+            proof_wires = list(proof_wires)
 
         batch = BatchVerifier(backend=self.backend)
         contexts: list[str | None] = []  # user_id once queued for verify, else None
@@ -594,9 +618,18 @@ class AuthServiceImpl:
         # dispatch lane's prep thread, overlapped with the previous
         # batch's device compute, so the decode cost leaves the RPC's
         # serial path entirely.
+        # when every entry survived screening, the native wire view's
+        # contiguous proof buffer (gathered in C straight off the socket
+        # bytes) feeds the batched parse with no Python re-join
+        packed = (
+            request.packed_proofs(n)
+            if len(live) == n and hasattr(request, "packed_proofs")
+            else None
+        )
         parsed = Proof.from_bytes_batch(
             [proof_wires[i] for i, _ in live],
             defer_point_validation=True,
+            packed=packed,
         )
         params = Parameters.new()  # shared generators: one instance per RPC
         for (i, user), proof in zip(live, parsed, strict=True):
@@ -843,7 +876,9 @@ class AuthServiceImpl:
         """Validate + admit one chunk message, consume its challenges,
         and dispatch the survivors into the batcher WITHOUT awaiting —
         the caller keeps reading while the device works."""
-        ids = list(request.ids)
+        wire_mod.note_wire_parse(request, rctx.trace_id)
+        ids = request.ids
+        ids = list(ids) if type(ids) is not list else ids
         n = len(ids)
         work = _StreamChunk(ids=ids, size=max(n, 1),
                             mint=bool(request.mint_sessions))
@@ -864,9 +899,16 @@ class AuthServiceImpl:
             )
             return work
         metrics.counter("auth.stream.proofs_count").inc(n)
-        user_ids = list(request.user_ids)
-        challenge_ids = list(request.challenge_ids)
-        proof_wires = list(request.proofs)
+        user_ids = request.user_ids
+        user_ids = list(user_ids) if type(user_ids) is not list else user_ids
+        challenge_ids = request.challenge_ids
+        if type(challenge_ids) is not list:
+            challenge_ids = list(challenge_ids)
+        proof_wires = request.proofs
+        if type(proof_wires) is not list:
+            proof_wires = list(proof_wires)
+        if hasattr(request, "packed_proofs"):
+            work.packed = request.packed_proofs(n)
         work.messages = [""] * n
         work.results = [_UNSET] * n
         work.user_ids = user_ids
@@ -932,6 +974,12 @@ class AuthServiceImpl:
         parsed = Proof.from_bytes_batch(
             [work.proof_wires[i] for i in live],
             defer_point_validation=True,
+            # native wire views: the C-gathered contiguous proof buffer
+            # feeds the batched parse when every entry survived screening
+            packed=(
+                work.packed
+                if len(live) == len(work.proof_wires) else None
+            ),
         )
         params = Parameters.new()
         deadline = rctx.deadline
@@ -1079,6 +1127,9 @@ class _StreamChunk:     # unsettled set until their verdicts are yielded
     users: dict[int, UserData] = field(default_factory=dict)
     results: list = field(default_factory=list)  # i -> verdict | _UNSET
     task: asyncio.Task | None = None
+    #: native wire view's contiguous proof buffer (None on the protobuf
+    #: path or when any proof has a non-canonical size)
+    packed: bytes | None = None
 
 
 def _proof_args_error(challenge_id: bytes, proof: bytes, index: int | None = None) -> str | None:
@@ -1094,10 +1145,36 @@ def _proof_args_error(challenge_id: bytes, proof: bytes, index: int | None = Non
     return None
 
 
+def request_deserializers(pb2, wire: str = "native") -> dict:
+    """{rpc name: request deserializer} for all six RPCs.  With
+    ``wire="native"`` the three hot messages (``CreateChallenge``,
+    ``VerifyProofBatch``, ``VerifyProofStream`` chunks) go through the
+    native wire parser first (``server/wire.py``), falling back to the
+    protobuf runtime for anything outside its recognized subset — with
+    ``wire="python"`` (or no loadable ``.so``) every message takes
+    ``FromString``, today's path unchanged.  Shared by the in-process
+    listener and the sharded-ingest processes so the two ingest shapes
+    cannot drift."""
+    types = method_types(pb2)
+    stream_types = stream_method_types(pb2)
+    out = {name: req.FromString for name, (req, _resp) in types.items()}
+    out["VerifyProofStream"] = stream_types["VerifyProofStream"][0].FromString
+    if wire == "native" and wire_mod.native_available():
+        for name in ("CreateChallenge", "VerifyProofBatch",
+                     "VerifyProofStream"):
+            req_cls = (stream_types if name == "VerifyProofStream"
+                       else types)[name][0]
+            deser = wire_mod.make_deserializer(name, req_cls)
+            if deser is not None:
+                out[name] = deser
+    return out
+
+
 def make_generic_handler(service: AuthServiceImpl) -> grpc.GenericRpcHandler:
     """Register the six RPCs without generated *_pb2_grpc stubs."""
     pb2 = service.pb2
     types = method_types(pb2)
+    desers = request_deserializers(pb2, service.wire)
     impl = {
         "Register": service.register,
         "RegisterBatch": service.register_batch,
@@ -1108,7 +1185,7 @@ def make_generic_handler(service: AuthServiceImpl) -> grpc.GenericRpcHandler:
     handlers = {
         name: grpc.unary_unary_rpc_method_handler(
             impl[name],
-            request_deserializer=types[name][0].FromString,
+            request_deserializer=desers[name],
             response_serializer=types[name][1].SerializeToString,
         )
         for name in impl
@@ -1116,7 +1193,7 @@ def make_generic_handler(service: AuthServiceImpl) -> grpc.GenericRpcHandler:
     stream_types = stream_method_types(pb2)
     handlers["VerifyProofStream"] = grpc.stream_stream_rpc_method_handler(
         service.verify_proof_stream,
-        request_deserializer=stream_types["VerifyProofStream"][0].FromString,
+        request_deserializer=desers["VerifyProofStream"],
         response_serializer=stream_types["VerifyProofStream"][1].SerializeToString,
     )
     return grpc.method_handlers_generic_handler(SERVICE_NAME, handlers)
@@ -1136,6 +1213,8 @@ async def serve(
     stream_window: int = 8192,
     stream_entry_deadline_ms: float = 0.0,
     fleet=None,
+    wire: str = "native",
+    listen: bool = True,
 ):
     """Build and start an aio server; returns (server, bound_port).
 
@@ -1161,6 +1240,13 @@ async def serve(
     then checks partition ownership before touching state and redirects
     wrong-partition requests with the map version + owner address in
     trailing metadata (docs/operations.md §"Partitioned fleet").
+    ``wire`` selects the transport parse path ("native" = the C++ wire
+    scanner with unconditional protobuf fallback, "python" = protobuf
+    runtime only — see ``server/wire.py``); ``listen=False`` starts the
+    server portless for the sharded-ingest mode, where SO_REUSEPORT
+    listener processes own the public address and feed the handlers over
+    the :class:`~cpzk_tpu.server.ingest.IngestSupervisor` seam
+    (docs/operations.md §"Wire path & ingest shards").
     """
     server = grpc.aio.server()
     service = AuthServiceImpl(
@@ -1168,7 +1254,7 @@ async def serve(
         admission=admission, replica=replica, audit_log=audit_log,
         stream_window=stream_window,
         stream_entry_deadline_ms=stream_entry_deadline_ms,
-        fleet=fleet,
+        fleet=fleet, wire=wire,
     )
     server.add_generic_rpc_handlers((make_generic_handler(service),))
     if replica is not None:
@@ -1186,6 +1272,13 @@ async def serve(
     server.fleet = fleet  # ops plane: /partitionmap + /statusz fleet block
     if batcher is not None:
         batcher.start()
+    if not listen:
+        # sharded-ingest mode: the SO_REUSEPORT listener processes own
+        # the public port; this dispatch-process server starts portless
+        # (handlers reachable only through the ingest supervisor's
+        # framed unix-socket seam)
+        await server.start()
+        return server, None
     addr = f"{host}:{port}"
     if tls is not None:
         creds = grpc.ssl_server_credentials([tls])

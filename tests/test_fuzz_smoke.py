@@ -41,6 +41,7 @@ def _load(name: str):
         ("fuzz_lint", 150),
         ("fuzz_audit_log", 400),
         ("fuzz_partition_map", 400),
+        ("fuzz_wire_parse", 400),
     ],
 )
 def test_fuzz_target_smoke(target, runs):

@@ -592,7 +592,10 @@ def test_perf_entry_lanes_is_a_config_key(tmp_path):
     report = compare_entries(old, new, threshold=0.35)
     assert report["passed"], report  # the 8-lane entry is only_new
     assert report["only_new"] == [
-        ("e2e_curve.grpc", "cpu", 256, "proofs/s", 8)
+        # the key carries every config component: lanes (this test's
+        # subject) and the transport wire mode (defaults to "python" —
+        # exactly what pre-wire baselines measured)
+        ("e2e_curve.grpc", "cpu", 256, "proofs/s", 8, "python")
     ]
     # round-trips: lanes serialized only when != 1, parsed back into key
     path = str(tmp_path / "snap.json")

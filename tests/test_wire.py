@@ -1,0 +1,487 @@
+"""Native wire path (server/wire.py + native/wire.cpp): the C++ request
+parser must be OBSERVATIONALLY IDENTICAL to the protobuf runtime — same
+field values on accepted messages, unconditional fallback for anything
+else, byte-identical per-entry verdicts through the service layer — and
+the packed-proof staging buffer must change where work happens, never
+what it computes.
+"""
+
+import asyncio
+import dataclasses
+import os
+import pathlib
+import re
+import subprocess
+import sys
+
+import pytest
+
+from cpzk_tpu import Parameters, Prover, SecureRng, Transcript, Witness
+from cpzk_tpu.client import AuthClient
+from cpzk_tpu.core.ristretto import Ristretto255
+from cpzk_tpu.protocol.gadgets import PROOF_WIRE_SIZE, Proof
+from cpzk_tpu.server import RateLimiter, ServerState, wire as wire_mod
+from cpzk_tpu.server.config import ServerConfig, ServerSettings
+from cpzk_tpu.server.proto import load_pb2
+from cpzk_tpu.server.service import request_deserializers, serve
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+
+pytestmark = pytest.mark.skipif(
+    not wire_mod.native_available(),
+    reason="native core unavailable (no C++ toolchain)",
+)
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def _deser(rpc):
+    return request_deserializers(load_pb2(), "native")[rpc]
+
+
+# --- view parity -------------------------------------------------------------
+
+
+def test_batch_view_parity_with_protobuf():
+    pb2 = load_pb2()
+    req = pb2.BatchVerificationRequest(
+        user_ids=[f"user{i}" for i in range(50)] + ["héllo-ü"],
+        challenge_ids=[bytes([i]) * 33 for i in range(51)],
+        proofs=[bytes([i]) * PROOF_WIRE_SIZE for i in range(51)],
+    )
+    data = req.SerializeToString()
+    view = _deser("VerifyProofBatch")(data)
+    assert isinstance(view, wire_mod.NativeBatchVerificationRequest)
+    ref = pb2.BatchVerificationRequest.FromString(data)
+    assert view.user_ids == list(ref.user_ids)
+    assert view.challenge_ids == list(ref.challenge_ids)
+    assert view.proofs == list(ref.proofs)
+    # the zero-copy payoff: the C-gathered buffer IS the concatenation
+    assert view.proofs_packed == b"".join(ref.proofs)
+    assert view.packed_proofs(51) == view.proofs_packed
+    assert view.packed_proofs(50) is None  # subset: no contiguity claim
+
+
+def test_stream_view_parity_with_protobuf():
+    pb2 = load_pb2()
+    req = pb2.StreamVerifyRequest(
+        ids=[0, 1, 2**63, 7],
+        user_ids=["a", "b", "c", "d"],
+        challenge_ids=[b"x" * 33] * 4,
+        proofs=[bytes(PROOF_WIRE_SIZE)] * 4,
+        mint_sessions=True,
+    )
+    data = req.SerializeToString()
+    view = _deser("VerifyProofStream")(data)
+    assert isinstance(view, wire_mod.NativeStreamVerifyRequest)
+    ref = pb2.StreamVerifyRequest.FromString(data)
+    assert view.ids == list(ref.ids)
+    assert view.user_ids == list(ref.user_ids)
+    assert view.challenge_ids == list(ref.challenge_ids)
+    assert view.proofs == list(ref.proofs)
+    assert view.mint_sessions is True
+    # unpacked (wiretype-0) ids are legal proto3 too: field 1, varint
+    unpacked = b"\x08\x05\x08\x2a" + data
+    view2 = _deser("VerifyProofStream")(unpacked)
+    ref2 = pb2.StreamVerifyRequest.FromString(unpacked)
+    assert view2.ids == list(ref2.ids)
+
+
+def test_challenge_view_parity_with_protobuf():
+    pb2 = load_pb2()
+    for uid in ("alice", "héllo-ü", "", "a" * 300):
+        data = pb2.ChallengeRequest(user_id=uid).SerializeToString()
+        view = _deser("CreateChallenge")(data)
+        assert isinstance(view, wire_mod.NativeChallengeRequest)
+        assert view.user_id == pb2.ChallengeRequest.FromString(data).user_id
+    # duplicated singular field: last occurrence wins, like proto3
+    twice = (pb2.ChallengeRequest(user_id="first").SerializeToString()
+             + pb2.ChallengeRequest(user_id="second").SerializeToString())
+    assert _deser("CreateChallenge")(twice).user_id == "second"
+    assert pb2.ChallengeRequest.FromString(twice).user_id == "second"
+
+
+def test_parser_punts_outside_its_subset():
+    """Unknown fields, foreign wire types, and invalid UTF-8 all fall
+    back to the protobuf runtime — same accept/reject, same errors."""
+    pb2 = load_pb2()
+    deser = _deser("CreateChallenge")
+    base = pb2.ChallengeRequest(user_id="u").SerializeToString()
+    # unknown field number: protobuf accepts (unknown-field set); the
+    # native parser punts, so the result is the protobuf message itself
+    unknown = base + b"\x22\x01x"  # field 4, LEN
+    got = deser(unknown)
+    assert type(got).__name__ == "ChallengeRequest"
+    assert got.user_id == "u"
+    # invalid UTF-8 in a string field: both paths reject identically
+    bad_utf8 = b"\x0a\x02\xff\xfe"
+    with pytest.raises(Exception) as native_err:
+        deser(bad_utf8)
+    with pytest.raises(Exception) as py_err:
+        pb2.ChallengeRequest.FromString(bad_utf8)
+    assert type(native_err.value) is type(py_err.value)
+    # truncated varint / garbage: both reject
+    for garbage in (b"\x0a", b"\x0a\xff", b"\x80" * 12, b"\x0a\x7fzz"):
+        try:
+            ref = pb2.ChallengeRequest.FromString(garbage)
+        except Exception:
+            with pytest.raises(Exception):
+                deser(garbage)
+        else:
+            got = deser(garbage)
+            assert got.user_id == ref.user_id
+
+
+def test_packed_proofs_none_when_sizes_vary():
+    pb2 = load_pb2()
+    req = pb2.BatchVerificationRequest(
+        user_ids=["a", "b"], challenge_ids=[b"c" * 33] * 2,
+        proofs=[bytes(PROOF_WIRE_SIZE), b"short"],
+    )
+    view = _deser("VerifyProofBatch")(req.SerializeToString())
+    assert view.proofs_packed is None
+    assert view.proofs == [bytes(PROOF_WIRE_SIZE), b"short"]
+
+
+# --- packed parse equivalence ------------------------------------------------
+
+
+def _proof_corpus():
+    rng = SecureRng()
+    params = Parameters.new()
+    prover = Prover(params, Witness(Ristretto255.random_scalar(rng)))
+    t = Transcript()
+    t.append_context(b"ctx")
+    wire = prover.prove_with_transcript(rng, t).to_bytes()
+    return [
+        wire,
+        wire[:5] + b"\xff" * 32 + wire[37:],   # invalid r1 point
+        wire[:77] + bytes(32),                 # zero scalar
+        b"\x02" + wire[1:],                    # bad version
+        wire,
+    ]
+
+
+def test_from_bytes_batch_packed_equivalence():
+    items = _proof_corpus()
+    assert all(len(i) == PROOF_WIRE_SIZE for i in items)
+    packed = b"".join(items)
+    for defer in (False, True):
+        plain = Proof.from_bytes_batch(items, defer_point_validation=defer)
+        fast = Proof.from_bytes_batch(
+            items, defer_point_validation=defer, packed=packed)
+        for a, b in zip(plain, fast, strict=True):
+            if isinstance(a, Proof):
+                assert isinstance(b, Proof)
+                assert a.to_bytes() == b.to_bytes()
+                assert a.deferred == b.deferred
+            else:
+                assert type(a) is type(b) and str(a) == str(b)
+    # a mismatched packed buffer is ignored, never trusted
+    wrong = packed[:-1]
+    safe = Proof.from_bytes_batch(items, packed=wrong)
+    assert all(
+        type(x) is type(y) for x, y in zip(
+            safe, Proof.from_bytes_batch(items), strict=True)
+    )
+
+
+# --- service-layer parity (the satellite-3 pin) ------------------------------
+
+
+async def _serve_and_verify_mixed(wire: str):
+    """One coalesced batch with malformed wires through a real server at
+    the given wire mode; returns (per-entry (success, message) list,
+    stream verdict list, transport counters delta is asserted by the
+    caller)."""
+    rng = SecureRng()
+    params = Parameters.new()
+    provers = [Prover(params, Witness(Ristretto255.random_scalar(rng)))
+               for _ in range(4)]
+    eb = Ristretto255.element_to_bytes
+    state = ServerState()
+    server, port = await serve(
+        state, RateLimiter(10**9, 10**9), port=0, wire=wire)
+    try:
+        async with AuthClient(f"127.0.0.1:{port}") as client:
+            resp = await client.register_batch(
+                [f"u{i}" for i in range(4)],
+                [eb(p.statement.y1) for p in provers],
+                [eb(p.statement.y2) for p in provers],
+            )
+            assert all(r.success for r in resp.results)
+
+            async def wave():
+                ids, cids, proofs = [], [], []
+                for i, p in enumerate(provers):
+                    ch = await client.create_challenge(f"u{i}")
+                    cid = bytes(ch.challenge_id)
+                    t = Transcript()
+                    t.append_context(cid)
+                    ids.append(f"u{i}")
+                    cids.append(cid)
+                    proofs.append(p.prove_with_transcript(rng, t).to_bytes())
+                return ids, cids, proofs
+
+            ids, cids, proofs = await wave()
+            # malformed wires INSIDE the coalesced batch: truncated,
+            # bad point, zero scalar, plus one valid
+            proofs[1] = proofs[1][:50]
+            proofs[2] = proofs[2][:5] + b"\xff" * 32 + proofs[2][37:]
+            resp = await client.verify_proof_batch(ids, cids, proofs)
+            batch_out = [(r.success, r.message) for r in resp.results]
+
+            ids, cids, proofs = await wave()
+            proofs[0] = b""
+            proofs[3] = proofs[3] + b"\x00"
+            entries = list(zip(ids, cids, proofs))
+            stream_out = []
+            async for chunk in client.verify_proof_stream_chunks(
+                entries, chunk=4
+            ):
+                stream_out.append((list(chunk[0]), list(chunk[1]),
+                                   list(chunk[2])))
+            return batch_out, stream_out
+    finally:
+        await server.stop(None)
+
+
+def test_malformed_batch_parity_native_vs_python():
+    """Satellite 3: a coalesced batch containing malformed wires answers
+    IDENTICALLY (per-entry verdicts and messages) through the native
+    wire path and the Python protobuf path."""
+    native = run(_serve_and_verify_mixed("native"))
+    python = run(_serve_and_verify_mixed("python"))
+    assert native == python
+    batch_out, stream_out = native
+    assert batch_out[0][0] is True
+    assert batch_out[1] == (
+        False, "Invalid proof: Truncated proof: incomplete r2 data")
+    assert batch_out[2][0] is False  # deferred decode failure, exact msg
+    assert "Invalid proof" in batch_out[2][1]
+    assert batch_out[3][0] is True
+    (ids, oks, msgs), = stream_out
+    assert oks == [False, True, True, False]
+    assert msgs[0] == "Empty proof"
+    assert msgs[3] == "Invalid proof: Proof has 1 trailing bytes"
+
+
+def test_native_counters_and_span(tmp_path):
+    from cpzk_tpu.server import metrics
+
+    before = metrics.read(
+        "transport.parse.native", labels={"rpc": "VerifyProofBatch"})
+    run(_serve_and_verify_mixed("native"))
+    after = metrics.read(
+        "transport.parse.native", labels={"rpc": "VerifyProofBatch"})
+    assert after > before
+
+
+def test_python_mode_never_builds_views():
+    desers = request_deserializers(load_pb2(), "python")
+    pb2 = load_pb2()
+    req = pb2.ChallengeRequest(user_id="u").SerializeToString()
+    assert type(desers["CreateChallenge"](req)).__name__ == "ChallengeRequest"
+
+
+def test_fallback_when_native_unavailable(monkeypatch):
+    monkeypatch.setattr(wire_mod, "native_available", lambda: False)
+    desers = request_deserializers(load_pb2(), "native")
+    pb2 = load_pb2()
+    req = pb2.ChallengeRequest(user_id="u").SerializeToString()
+    assert type(desers["CreateChallenge"](req)).__name__ == "ChallengeRequest"
+
+
+_NO_NATIVE_SCRIPT = """
+import asyncio, os
+# simulate a box with no buildable native core: the .so path is empty
+# and CPZK_NO_NATIVE_BUILD forbids building one
+import cpzk_tpu.core._native as native
+native._LIB_PATH = os.path.join("%s", "missing.so")
+native._tried = False
+
+from cpzk_tpu import Parameters, Prover, SecureRng, Transcript, Witness
+from cpzk_tpu.client import AuthClient
+from cpzk_tpu.core.ristretto import Ristretto255
+from cpzk_tpu.server import RateLimiter, ServerState
+from cpzk_tpu.server import wire as wire_mod
+from cpzk_tpu.server.service import serve
+
+assert not wire_mod.native_available()
+
+async def main():
+    rng = SecureRng(); params = Parameters.new()
+    p = Prover(params, Witness(Ristretto255.random_scalar(rng)))
+    eb = Ristretto255.element_to_bytes
+    server, port = await serve(
+        ServerState(), RateLimiter(10**9, 10**9), port=0, wire="native")
+    async with AuthClient(f"127.0.0.1:{port}") as c:
+        r = await c.register("u", eb(p.statement.y1), eb(p.statement.y2))
+        assert r.success
+        ch = await c.create_challenge("u")
+        cid = bytes(ch.challenge_id)
+        t = Transcript(); t.append_context(cid)
+        resp = await c.verify_proof(
+            "u", cid, p.prove_with_transcript(rng, t).to_bytes())
+        assert resp.success
+        ch = await c.create_challenge("u")
+        resp = await c.verify_proof_batch(["u"], [bytes(ch.challenge_id)],
+                                          [b"zz"])
+        assert resp.results[0].message == \\
+            "Invalid proof: Proof too small: 2 bytes", resp.results[0].message
+    await server.stop(None)
+    print("NO-NATIVE-OK")
+
+asyncio.run(main())
+"""
+
+
+def test_no_native_build_env_serves_identically(tmp_path):
+    """Acceptance: with CPZK_NO_NATIVE_BUILD=1 (and no .so) the wire
+    path falls back to the Python parse with no behavioral difference."""
+    env = dict(os.environ)
+    env["CPZK_NO_NATIVE_BUILD"] = "1"
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    result = subprocess.run(
+        [sys.executable, "-c", _NO_NATIVE_SCRIPT % tmp_path],
+        capture_output=True, text=True, cwd=str(ROOT), env=env, timeout=120,
+    )
+    assert result.returncode == 0, result.stderr
+    assert "NO-NATIVE-OK" in result.stdout
+
+
+def test_build_failure_warns_once(tmp_path, monkeypatch, caplog):
+    """Satellite 1: a failing native build logs ONE WARNING carrying the
+    compiler stderr instead of being swallowed silently."""
+    import logging
+
+    import cpzk_tpu.core._native as native
+
+    monkeypatch.setattr(native, "_build_warned", False)
+    monkeypatch.setattr(native, "_SRC_DIR", str(tmp_path))  # no Makefile
+    monkeypatch.delenv("CPZK_NO_NATIVE_BUILD", raising=False)
+    with caplog.at_level(logging.WARNING, logger="cpzk_tpu.core.native"):
+        assert native._build() is False
+        assert native._build() is False  # second failure: no second warn
+    warnings = [r for r in caplog.records if "native core build failed" in r.message]
+    assert len(warnings) == 1
+    assert "make -C" in warnings[0].message
+    # the deliberate opt-out stays silent (distinguishable by design)
+    caplog.clear()
+    monkeypatch.setattr(native, "_build_warned", False)
+    monkeypatch.setenv("CPZK_NO_NATIVE_BUILD", "1")
+    with caplog.at_level(logging.WARNING, logger="cpzk_tpu.core.native"):
+        assert native._build() is False
+    assert not [r for r in caplog.records if "build failed" in r.message]
+
+
+# --- perf-gate wire key ------------------------------------------------------
+
+
+def test_perf_entry_wire_is_a_config_key(tmp_path):
+    """Satellite 4: ``wire`` is a perf-gate config-key component — old
+    baselines load as ``wire="python"`` (exactly what they measured),
+    native-wire entries never gate against them (only_new seeds the
+    trajectory), and the field serializes only when != python."""
+    import json
+    import pathlib
+
+    from cpzk_tpu.observability.perf import (
+        PerfEntry,
+        compare_entries,
+        load_snapshot,
+        write_snapshot,
+    )
+
+    old = [PerfEntry("e2e_curve.stream", "cpu", 65536, 2815.0, "proofs/s")]
+    new = [
+        PerfEntry("e2e_curve.stream", "cpu", 65536, 2800.0, "proofs/s"),
+        PerfEntry("e2e_curve.stream", "cpu", 65536, 10.0, "proofs/s",
+                  wire="native"),
+    ]
+    report = compare_entries(old, new, threshold=0.35)
+    assert report["passed"], report  # the native entry is only_new
+    assert report["only_new"] == [
+        ("e2e_curve.stream", "cpu", 65536, "proofs/s", 1, "native")
+    ]
+    path = str(tmp_path / "snap.json")
+    write_snapshot(path, new)
+    loaded = load_snapshot(path)
+    assert sorted(e.key() for e in loaded) == sorted(e.key() for e in new)
+    raw = json.loads(pathlib.Path(path).read_text())
+    assert sorted(
+        (e.get("wire") for e in raw["entries"]), key=str
+    ) == [None, "native"]
+
+
+# --- [server] config knobs ---------------------------------------------------
+
+
+def test_server_config_layering_and_validation(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    cfg = ServerConfig.from_env()
+    assert cfg.server.wire == "native"
+    assert cfg.server.ingest_shards == 1
+
+    (tmp_path / "server.toml").write_text(
+        '[server]\nwire = "python"\ningest_shards = 4\n'
+    )
+    monkeypatch.setenv("SERVER_CONFIG_PATH", str(tmp_path / "server.toml"))
+    cfg = ServerConfig.from_env()
+    assert cfg.server.wire == "python"
+    assert cfg.server.ingest_shards == 4
+    cfg.validate()
+    monkeypatch.setenv("SERVER_WIRE", "NATIVE")
+    monkeypatch.setenv("SERVER_INGEST_SHARDS", "2")
+    cfg = ServerConfig.from_env()
+    assert cfg.server.wire == "native"
+    assert cfg.server.ingest_shards == 2
+
+    bad = ServerConfig()
+    bad.server.wire = "rust"
+    with pytest.raises(ValueError, match="server.wire"):
+        bad.validate()
+    bad = ServerConfig()
+    bad.server.ingest_shards = 0
+    with pytest.raises(ValueError, match="ingest_shards"):
+        bad.validate()
+    bad = ServerConfig()
+    bad.server.ingest_shards = 65
+    with pytest.raises(ValueError, match="ingest_shards"):
+        bad.validate()
+    # ingest shards proxy only auth + health: a standby must listen itself
+    bad = ServerConfig()
+    bad.server.ingest_shards = 2
+    bad.state_file = "/tmp/x.json"
+    bad.durability.enabled = True
+    bad.replication.enabled = True
+    bad.replication.role = "standby"
+    with pytest.raises(ValueError, match="ingest_shards"):
+        bad.validate()
+
+
+def test_server_config_keys_documented():
+    """CI drift guard: every [server] knob ships in the TOML example, the
+    .env example, and the operations-doc knob inventory."""
+    keys = [f.name for f in dataclasses.fields(ServerSettings)]
+    assert keys
+
+    toml_text = (ROOT / "config" / "server.toml.example").read_text()
+    m = re.search(r"^\[server\]$", toml_text, re.M)
+    assert m, "[server] section missing from config/server.toml.example"
+    section = toml_text[m.end():].split("\n[", 1)[0]
+    env_text = (ROOT / ".env.example").read_text()
+    docs = (ROOT / "docs" / "operations.md").read_text()
+    for key in keys:
+        assert re.search(rf"^{key}\s*=", section, re.M), (
+            f"[server] key {key!r} missing from config/server.toml.example"
+        )
+        assert f"SERVER_{key.upper()}" in env_text, (
+            f"SERVER_{key.upper()} missing from .env.example"
+        )
+        assert f"`server.{key}`" in docs, (
+            f"`server.{key}` missing from the docs/operations.md "
+            "knob inventory"
+        )
