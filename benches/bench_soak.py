@@ -1,0 +1,579 @@
+"""Million-user state-plane soak: registration, mixed traffic, failover.
+
+ROADMAP item 6 / ISSUE 14: nothing before this harness ever held 1M+
+registered users, so snapshot pause, WAL compaction behavior, expiry
+sweep cost, and steady-state RSS all had unmeasured constants.  This
+driver registers ``--users`` users against a REAL daemon subprocess
+(``python -m cpzk_tpu.server``) configured the million-user way
+(raised capacity caps, durability + segmented WAL, ops plane), then
+drives mixed login / verify-batch / stream traffic at a target QPS and
+records into a ``BENCH_SOAK.json`` the perf-regression gate
+(``python -m cpzk_tpu.observability.regress``) understands:
+
+- per-RPC p50/p99 client latency (``ms``, lower is better) for the
+  challenge+login pair, the batched verify, and the stream chunk;
+- the daemon's longest synchronous snapshot cut
+  (``state.snapshot.max_pause_ms`` — the streaming-snapshot acceptance
+  number) and longest sweep (``state.sweep.max_ms``), scraped from the
+  ops plane;
+- steady-state RSS of the daemon (``bytes``) sampled from
+  ``/proc/<pid>/status``;
+- sealed WAL segment count at the end of the run;
+- optionally (``--failover``) a replicated-pair leg: the primary is
+  SIGKILLed mid-soak and the time until the auto-promoted standby
+  serves a full login is recorded (``ms``).
+
+Scaled-down smoke: ``--users 50000 --qps 300 --duration 20`` finishes
+in about a minute on one core and is what CI's ``soak-smoke`` job gates
+against the committed ``BENCH_SOAK_BASELINE.json``; the committed
+``BENCH_SOAK.json`` is a full 1M-user CPU run.
+
+Usage::
+
+    python benches/bench_soak.py --users 1000000 --qps 1000 \
+        --duration 60 --snapshot BENCH_SOAK.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import shutil
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+POOL = 256           # distinct keypairs; users share statements round-robin
+REG_BATCH = 1000     # register_batch chunk (MAX_BATCH service parity)
+BATCH_N = 32         # proofs per verify-batch op
+STREAM_N = 128       # proofs per stream-chunk op
+CONCURRENCY = 16     # in-flight soak ops cap
+
+
+def build_corpus():
+    from cpzk_tpu import Parameters, Prover, SecureRng, Witness
+    from cpzk_tpu.core.ristretto import Ristretto255
+
+    rng = SecureRng()
+    params = Parameters.new()
+    provers = [
+        Prover(params, Witness(Ristretto255.random_scalar(rng)))
+        for _ in range(POOL)
+    ]
+    eb = Ristretto255.element_to_bytes
+    y1s = [eb(p.statement.y1) for p in provers]
+    y2s = [eb(p.statement.y2) for p in provers]
+    return rng, provers, y1s, y2s
+
+
+# -- daemon management --------------------------------------------------------
+
+
+def daemon_env(
+    state_dir: str,
+    users: int,
+    ops_port: int,
+    role: str | None = None,
+    peer: str | None = None,
+    wal_segment_bytes: int = 4 * 1024 * 1024,
+) -> dict:
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "SERVER_CONFIG_PATH": os.path.join(state_dir, "nonexistent.toml"),
+        "SERVER_STATE_FILE": os.path.join(state_dir, "state.json"),
+        # million-user shape: caps sized to the corpus, durability with a
+        # segment-rotated WAL so compaction never copies the tail
+        "SERVER_MAX_USERS": str(max(users * 2, 10_000)),
+        "SERVER_MAX_SESSIONS": str(max(users * 2, 100_000)),
+        "SERVER_MAX_CHALLENGES": str(max(users, 50_000)),
+        "SERVER_DURABILITY_ENABLED": "1",
+        "SERVER_DURABILITY_FSYNC": "interval",
+        "SERVER_DURABILITY_FSYNC_INTERVAL_MS": "100",
+        "SERVER_DURABILITY_WAL_SEGMENT_BYTES": str(wal_segment_bytes),
+        "SERVER_DURABILITY_COMPACT_BYTES": str(8 * 1024 * 1024),
+        "SERVER_OPSPLANE_ENABLED": "1",
+        "SERVER_OPSPLANE_PORT": str(ops_port),
+        "SERVER_RATE_LIMIT_REQUESTS_PER_MINUTE": "1000000000",
+        "SERVER_RATE_LIMIT_BURST": "100000000",
+        # sweeps + checkpoints on a soak-visible cadence
+        "CPZK_CLEANUP_INTERVAL_S": os.environ.get("CPZK_CLEANUP_INTERVAL_S", "15"),
+    })
+    if role is not None:
+        env.update({
+            "SERVER_REPLICATION_ENABLED": "1",
+            "SERVER_REPLICATION_ROLE": role,
+            "SERVER_REPLICATION_MODE": "async",
+            "SERVER_REPLICATION_LEASE_MS": "2000",
+            "SERVER_REPLICATION_RENEW_INTERVAL_MS": "400",
+        })
+        if peer is not None:
+            env["SERVER_REPLICATION_PEER"] = peer
+    return env
+
+
+def spawn_daemon(port: int, env: dict, log_path: str) -> subprocess.Popen:
+    log_f = open(log_path, "ab")
+    return subprocess.Popen(
+        [sys.executable, "-m", "cpzk_tpu.server", "--no-repl",
+         "--host", "127.0.0.1", "--port", str(port)],
+        env=env, stdout=log_f, stderr=log_f,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+
+
+def wait_healthy(ops_port: int, timeout_s: float = 120.0) -> None:
+    deadline = time.monotonic() + timeout_s
+    url = f"http://127.0.0.1:{ops_port}/healthz"
+    while time.monotonic() < deadline:
+        try:
+            with urllib.request.urlopen(url, timeout=2) as r:
+                if r.status == 200:
+                    return
+        except OSError:
+            pass
+        time.sleep(0.25)
+    raise RuntimeError(f"daemon ops plane on :{ops_port} never became healthy")
+
+
+def scrape_metrics(ops_port: int) -> dict[str, float]:
+    """Flat {name_with_labels: value} off the ops plane's /metrics text."""
+    out: dict[str, float] = {}
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{ops_port}/metrics", timeout=5
+    ) as r:
+        for line in r.read().decode().splitlines():
+            if not line or line.startswith("#"):
+                continue
+            parts = line.rsplit(" ", 1)
+            if len(parts) != 2:
+                continue
+            try:
+                out[parts[0]] = float(parts[1])
+            except ValueError:
+                continue
+    return out
+
+
+def rss_bytes(pid: int) -> int:
+    try:
+        with open(f"/proc/{pid}/status") as f:
+            for line in f:
+                if line.startswith("VmRSS:"):
+                    return int(line.split()[1]) * 1024
+    except OSError:
+        pass
+    return 0
+
+
+# -- phases -------------------------------------------------------------------
+
+
+async def register_users(address: str, users: int, y1s, y2s) -> float:
+    """Register ``users`` distinct ids (statements drawn from the keypair
+    pool round-robin — state size is what the soak measures, not keygen
+    throughput); returns registrations/s."""
+    from cpzk_tpu.client import AuthClient
+
+    t0 = time.monotonic()
+    async with AuthClient(address) as client:
+        done = 0
+        while done < users:
+            n = min(REG_BATCH, users - done)
+            ids = [f"su{done + k}" for k in range(n)]
+            resp = await client.register_batch(
+                ids,
+                [y1s[(done + k) % POOL] for k in range(n)],
+                [y2s[(done + k) % POOL] for k in range(n)],
+                timeout=120.0,
+            )
+            bad = [r.message for r in resp.results if not r.success]
+            assert not bad, f"registration failed: {bad[:3]}"
+            done += n
+            if done % 100_000 < REG_BATCH:
+                dt = time.monotonic() - t0
+                print(f"# registered {done}/{users} ({done / dt:.0f}/s)",
+                      file=sys.stderr, flush=True)
+    return users / (time.monotonic() - t0)
+
+
+def percentile(values: list[float], q: float) -> float:
+    if not values:
+        return 0.0
+    values = sorted(values)
+    idx = min(len(values) - 1, max(0, int(round(q / 100.0 * (len(values) - 1)))))
+    return values[idx]
+
+
+async def soak_traffic(
+    address: str, users: int, qps: float, duration: float, rng, provers,
+    lat: dict[str, list[float]], errors: list[str],
+) -> int:
+    """Mixed traffic at ~``qps`` proofs/s for ``duration`` seconds:
+    single logins (challenge + VerifyProof, session minted), verify-proof
+    batches, and stream chunks, users drawn round-robin over the whole
+    registered corpus.  Returns proofs driven."""
+    from cpzk_tpu import Transcript
+    from cpzk_tpu.client import AuthClient
+
+    sem = asyncio.Semaphore(CONCURRENCY)
+    done_proofs = 0
+    user_cursor = 0
+
+    def next_users(n: int) -> list[tuple[str, int]]:
+        nonlocal user_cursor
+        out = [
+            (f"su{(user_cursor + k) % users}", (user_cursor + k) % POOL)
+            for k in range(n)
+        ]
+        user_cursor = (user_cursor + n) % users
+        return out
+
+    async with AuthClient(address) as client:
+
+        async def challenge_and_prove(uid: str, pool_idx: int):
+            t0 = time.monotonic()
+            ch = await client.create_challenge(uid)
+            lat["challenge"].append((time.monotonic() - t0) * 1000.0)
+            cid = bytes(ch.challenge_id)
+            t = Transcript()
+            t.append_context(cid)
+            proof = provers[pool_idx].prove_with_transcript(rng, t)
+            return cid, proof.to_bytes()
+
+        async def op_login():
+            nonlocal done_proofs
+            (uid, k), = next_users(1)
+            try:
+                cid, proof = await challenge_and_prove(uid, k)
+                t0 = time.monotonic()
+                resp = await client.verify_proof(uid, cid, proof)
+                lat["login"].append((time.monotonic() - t0) * 1000.0)
+                if not resp.success:
+                    errors.append(f"login: {resp.message}")
+                done_proofs += 1
+            except Exception as e:  # noqa: BLE001 - recorded, run continues
+                errors.append(f"login: {e!r}")
+
+        async def op_batch():
+            nonlocal done_proofs
+            picked = next_users(BATCH_N)
+            try:
+                pairs = await asyncio.gather(*[
+                    challenge_and_prove(uid, k) for uid, k in picked
+                ])
+                t0 = time.monotonic()
+                resp = await client.verify_proof_batch(
+                    [uid for uid, _ in picked],
+                    [cid for cid, _ in pairs],
+                    [proof for _, proof in pairs],
+                )
+                lat["verify_batch"].append((time.monotonic() - t0) * 1000.0)
+                bad = [r.message for r in resp.results if not r.success]
+                if bad:
+                    errors.append(f"batch: {bad[:2]}")
+                done_proofs += BATCH_N
+            except Exception as e:  # noqa: BLE001
+                errors.append(f"batch: {e!r}")
+
+        async def op_stream():
+            nonlocal done_proofs
+            picked = next_users(STREAM_N)
+            try:
+                pairs = await asyncio.gather(*[
+                    challenge_and_prove(uid, k) for uid, k in picked
+                ])
+                entries = [
+                    (uid, cid, proof)
+                    for (uid, _), (cid, proof) in zip(picked, pairs)
+                ]
+                t0 = time.monotonic()
+                ok = 0
+                async for chunk_v in client.verify_proof_stream_chunks(
+                    entries, chunk=STREAM_N
+                ):
+                    ok += sum(chunk_v[1])
+                lat["stream"].append((time.monotonic() - t0) * 1000.0)
+                if ok != STREAM_N:
+                    errors.append(f"stream: {ok}/{STREAM_N} ok")
+                done_proofs += STREAM_N
+            except Exception as e:  # noqa: BLE001
+                errors.append(f"stream: {e!r}")
+
+        # weighted schedule, paced by proofs-per-op against the target QPS
+        schedule = [(op_login, 1)] * 6 + [(op_batch, BATCH_N)] + \
+            [(op_login, 1)] * 6 + [(op_stream, STREAM_N)]
+        tasks: set[asyncio.Task] = set()
+        start = time.monotonic()
+        next_at = start
+        i = 0
+        while time.monotonic() - start < duration:
+            op, weight = schedule[i % len(schedule)]
+            i += 1
+            now = time.monotonic()
+            if now < next_at:
+                await asyncio.sleep(next_at - now)
+            next_at = max(next_at + weight / qps, time.monotonic() - 1.0)
+            await sem.acquire()
+
+            async def run(op=op):
+                try:
+                    await op()
+                finally:
+                    sem.release()
+
+            task = asyncio.ensure_future(run())
+            tasks.add(task)
+            task.add_done_callback(tasks.discard)
+        if tasks:
+            await asyncio.wait(tasks, timeout=60)
+    return done_proofs
+
+
+async def measure_failover(
+    standby_addr: str, primary: subprocess.Popen, rng, provers,
+) -> float:
+    """SIGKILL the primary, then poll the standby with full logins until
+    one succeeds; returns kill->first-served-login milliseconds."""
+    from cpzk_tpu import Transcript
+    from cpzk_tpu.client import AuthClient
+
+    primary.send_signal(signal.SIGKILL)
+    primary.wait(timeout=30)
+    t_kill = time.monotonic()
+    deadline = t_kill + 60.0
+    uid, k = "su0", 0
+    async with AuthClient(standby_addr) as client:
+        while time.monotonic() < deadline:
+            try:
+                ch = await client.create_challenge(uid, timeout=2.0)
+                cid = bytes(ch.challenge_id)
+                t = Transcript()
+                t.append_context(cid)
+                proof = provers[k].prove_with_transcript(rng, t)
+                resp = await client.verify_proof(
+                    uid, cid, proof.to_bytes(), timeout=2.0
+                )
+                if resp.success:
+                    return (time.monotonic() - t_kill) * 1000.0
+            except Exception:  # noqa: BLE001 - standby not promoted yet
+                await asyncio.sleep(0.05)
+    raise RuntimeError("standby never served a login after primary SIGKILL")
+
+
+# -- main ---------------------------------------------------------------------
+
+
+async def amain(args) -> int:
+    from cpzk_tpu.observability.perf import PerfEntry, write_snapshot
+
+    state_dir = args.state_dir or tempfile.mkdtemp(prefix="cpzk-soak-")
+    os.makedirs(state_dir, exist_ok=True)
+    primary_dir = os.path.join(state_dir, "primary")
+    os.makedirs(primary_dir, exist_ok=True)
+    address = f"127.0.0.1:{args.port}"
+
+    procs: list[subprocess.Popen] = []
+    standby = None
+    try:
+        if args.failover:
+            standby_dir = os.path.join(state_dir, "standby")
+            os.makedirs(standby_dir, exist_ok=True)
+            standby_port, standby_ops = args.port + 1, args.ops_port + 1
+            standby = spawn_daemon(
+                standby_port,
+                daemon_env(standby_dir, args.users, standby_ops,
+                           role="standby"),
+                os.path.join(state_dir, "standby.log"),
+            )
+            procs.append(standby)
+            wait_healthy(standby_ops)
+        primary = spawn_daemon(
+            args.port,
+            daemon_env(
+                primary_dir, args.users, args.ops_port,
+                role="primary" if args.failover else None,
+                peer=f"127.0.0.1:{args.port + 1}" if args.failover else None,
+            ),
+            os.path.join(state_dir, "primary.log"),
+        )
+        procs.append(primary)
+        wait_healthy(args.ops_port)
+
+        print(f"# daemon up (pid {primary.pid}); building corpus",
+              file=sys.stderr, flush=True)
+        rng, provers, y1s, y2s = build_corpus()
+        rss_before = rss_bytes(primary.pid)
+
+        reg_rate = await register_users(address, args.users, y1s, y2s)
+        rss_after_reg = rss_bytes(primary.pid)
+        print(f"# registration: {reg_rate:.0f} users/s, RSS "
+              f"{rss_after_reg / 1e6:.0f} MB", file=sys.stderr, flush=True)
+
+        lat: dict[str, list[float]] = {
+            "challenge": [], "login": [], "verify_batch": [], "stream": [],
+        }
+        errors: list[str] = []
+        rss_samples: list[int] = []
+
+        async def rss_sampler():
+            while True:
+                rss_samples.append(rss_bytes(primary.pid))
+                await asyncio.sleep(2.0)
+
+        sampler = asyncio.ensure_future(rss_sampler())
+        proofs = await soak_traffic(
+            address, args.users, args.qps, args.duration, rng, provers,
+            lat, errors,
+        )
+        sampler.cancel()
+
+        failover_ms = None
+        if args.failover:
+            assert standby is not None
+            failover_ms = await measure_failover(
+                f"127.0.0.1:{args.port + 1}", primary, rng, provers,
+            )
+            print(f"# failover: standby served a login {failover_ms:.0f} ms "
+                  "after primary SIGKILL", file=sys.stderr, flush=True)
+
+        # daemon-side numbers off the ops plane (primary may be dead after
+        # the failover leg — scrape what the soak window recorded first)
+        scraped: dict[str, float] = {}
+        if not args.failover:
+            scraped = scrape_metrics(args.ops_port)
+        snap_pause = scraped.get("state_snapshot_max_pause_ms", 0.0)
+        sweep_max = scraped.get("state_sweep_max_ms", 0.0)
+        wal_segments = scraped.get("state_wal_segments", 0.0)
+
+        steady = sorted(rss_samples[len(rss_samples) // 2:] or
+                        [rss_after_reg])
+        rss_steady = steady[len(steady) // 2]
+
+        err_rate = len(errors) / max(1, proofs)
+        report = {
+            "metric": "soak",
+            "users": args.users,
+            "qps_target": args.qps,
+            "duration_s": args.duration,
+            "proofs_driven": proofs,
+            "registration_users_per_s": round(reg_rate, 1),
+            "rss_before_bytes": rss_before,
+            "rss_after_registration_bytes": rss_after_reg,
+            "rss_steady_bytes": int(rss_steady),
+            "snapshot_max_pause_ms": snap_pause,
+            "sweep_max_ms": sweep_max,
+            "wal_segments": wal_segments,
+            "latency_ms": {
+                k: {"p50": round(percentile(v, 50), 3),
+                    "p99": round(percentile(v, 99), 3),
+                    "n": len(v)}
+                for k, v in lat.items()
+            },
+            "failover_ms": failover_ms,
+            "errors": len(errors),
+            "error_samples": errors[:5],
+        }
+        print(json.dumps(report), flush=True)
+        if errors:
+            print(f"# {len(errors)} errors (rate {err_rate:.5f}); first: "
+                  f"{errors[0]}", file=sys.stderr, flush=True)
+
+        if args.snapshot:
+            entries = [
+                PerfEntry("soak.register", "cpu", args.users,
+                          round(reg_rate, 1), "users/s"),
+                PerfEntry("soak.rss_steady", "cpu", args.users,
+                          float(int(rss_steady)), "bytes"),
+            ]
+            for kind in ("login", "verify_batch", "stream"):
+                values = lat[kind]
+                if not values:
+                    continue
+                entries.append(PerfEntry(
+                    f"soak.{kind}.p50", "cpu", args.users,
+                    round(percentile(values, 50), 3), "ms",
+                    spread=round(percentile(values, 75)
+                                 - percentile(values, 25), 3),
+                ))
+                entries.append(PerfEntry(
+                    f"soak.{kind}.p99", "cpu", args.users,
+                    round(percentile(values, 99), 3), "ms",
+                ))
+            if snap_pause > 0:
+                entries.append(PerfEntry(
+                    "soak.snapshot.max_pause", "cpu", args.users,
+                    round(snap_pause, 3), "ms",
+                ))
+            if sweep_max > 0:
+                entries.append(PerfEntry(
+                    "soak.sweep.max", "cpu", args.users,
+                    round(sweep_max, 3), "ms",
+                ))
+            if failover_ms is not None:
+                entries.append(PerfEntry(
+                    "soak.failover", "cpu", args.users,
+                    round(failover_ms, 1), "ms",
+                ))
+            write_snapshot(args.snapshot, entries, meta={
+                "bench": "bench_soak",
+                "users": args.users,
+                "qps": args.qps,
+                "duration_s": args.duration,
+                "platform": "host",
+                "wal_segments": wal_segments,
+                "proofs_driven": proofs,
+                "errors": len(errors),
+            })
+            print(f"# perf snapshot written to {args.snapshot}",
+                  file=sys.stderr, flush=True)
+        return 1 if (errors and args.strict) else 0
+    finally:
+        for proc in procs:
+            if proc.poll() is None:
+                proc.send_signal(signal.SIGTERM)
+        for proc in procs:
+            try:
+                proc.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+        if args.state_dir is None and not args.keep_state:
+            shutil.rmtree(state_dir, ignore_errors=True)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(
+        description="million-user state-plane soak against a live daemon"
+    )
+    ap.add_argument("--users", type=int, default=1_000_000)
+    ap.add_argument("--qps", type=float, default=1000.0,
+                    help="target mixed-traffic rate in proofs/s")
+    ap.add_argument("--duration", type=float, default=60.0,
+                    help="soak window seconds (after registration)")
+    ap.add_argument("--port", type=int, default=50161)
+    ap.add_argument("--ops-port", type=int, default=9161)
+    ap.add_argument("--snapshot", default=None,
+                    help="write a cpzk-perf-snapshot JSON here "
+                         "(BENCH_SOAK.json)")
+    ap.add_argument("--failover", action="store_true",
+                    help="run a replicated pair and SIGKILL the primary "
+                         "mid-soak, recording promotion-to-serving time")
+    ap.add_argument("--state-dir", default=None,
+                    help="daemon state directory (default: fresh tempdir, "
+                         "removed afterwards)")
+    ap.add_argument("--keep-state", action="store_true")
+    ap.add_argument("--strict", action="store_true",
+                    help="exit nonzero when any soak op errored")
+    args = ap.parse_args()
+    return asyncio.run(amain(args))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

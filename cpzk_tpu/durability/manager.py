@@ -76,6 +76,7 @@ class DurabilityManager:
             fsync_interval_ms=self.settings.fsync_interval_ms,
             start_seq=report.next_seq,
             faults=self.faults,
+            segment_bytes=getattr(self.settings, "wal_segment_bytes", 0),
         )
         self.state.attach_journal(self.wal)
         return report
@@ -160,6 +161,7 @@ class DurabilityManager:
         return {
             "wal_path": self.wal_path,
             "wal_bytes": wal.size if wal is not None else 0,
+            "wal_segments": wal.segment_count if wal is not None else 0,
             "wal_seq": wal.seq if wal is not None else 0,
             "covered_seq": self.covered_seq,
             "pending_appends": wal.pending if wal is not None else 0,

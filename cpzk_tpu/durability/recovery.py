@@ -27,7 +27,7 @@ from dataclasses import dataclass
 
 from ..observability import get_tracer
 from ..server import metrics
-from .wal import iter_frames
+from .wal import iter_frames, wal_sealed_segments
 
 log = logging.getLogger("cpzk_tpu.durability")
 
@@ -78,11 +78,28 @@ async def recover_state(state, snapshot_path: str, wal_path: str) -> RecoveryRep
 
     # 1. Read the WAL's valid prefix first: its last sequence number names
     #    the quarantine files, and a quarantined snapshot falls back to
-    #    replaying the log from seq 0.
+    #    replaying the log from seq 0.  A segmented log is scanned in name
+    #    order (sealed segments, then the active file) with the sequence
+    #    numbers threaded across file boundaries — one logical prefix.
     records: list[dict] = []
+    log_files = [(seg, False) for seg in wal_sealed_segments(wal_path)]
     if os.path.exists(wal_path):
-        def _read_log() -> bytes:
-            with open(wal_path, "rb") as f:
+        log_files.append((wal_path, True))
+    prev_seq: int | None = None
+    poisoned = False  # a corrupt SEALED file ends the trusted prefix
+    for fpath, is_active in log_files:
+        if poisoned:
+            # history past a corrupt sealed segment is unreachable (replay
+            # must never skip a gap): set it aside for the operator
+            dst = quarantine_file(fpath, int(time.time()))
+            log.error(
+                "ERROR: WAL file %s follows a corrupt sealed segment; "
+                "quarantined to %s", fpath, dst,
+            )
+            continue  # the reopened log O_CREATs a fresh active file
+
+        def _read_log(p=fpath) -> bytes:
+            with open(p, "rb") as f:
                 return f.read()
 
         try:
@@ -90,24 +107,34 @@ async def recover_state(state, snapshot_path: str, wal_path: str) -> RecoveryRep
             # may run with the health listener already up
             raw = await asyncio.to_thread(_read_log)
         except OSError as e:
-            report.wal_quarantined = quarantine_file(wal_path, int(time.time()))
+            dst = quarantine_file(fpath, int(time.time()))
+            report.wal_quarantined = report.wal_quarantined or dst
             log.error(
                 "ERROR: write-ahead log %s unreadable (%s); quarantined to %s",
-                wal_path, e, report.wal_quarantined,
+                fpath, e, dst,
             )
-            raw = b""
-        if raw:
-            records, valid = iter_frames(raw)
-            if not records:
-                # nonempty but yields no records: not a torn tail, the log
-                # is garbage from byte 0 — quarantine rather than truncate
-                # away what an operator may want to inspect
-                report.wal_quarantined = quarantine_file(wal_path, int(time.time()))
-                log.error(
-                    "ERROR: write-ahead log %s has no readable frames; "
-                    "quarantined to %s", wal_path, report.wal_quarantined,
-                )
-            elif valid < len(raw):
+            poisoned = not is_active
+            continue
+        if not raw:
+            continue
+        frecords, valid = iter_frames(raw, prev_seq=prev_seq)
+        if not frecords and valid == 0:
+            # nonempty but yields no records: not a torn tail, the file
+            # is garbage from byte 0 — quarantine rather than truncate
+            # away what an operator may want to inspect
+            dst = quarantine_file(fpath, int(time.time()))
+            report.wal_quarantined = report.wal_quarantined or dst
+            log.error(
+                "ERROR: write-ahead log %s has no readable frames; "
+                "quarantined to %s", fpath, dst,
+            )
+            poisoned = not is_active
+            continue
+        records.extend(frecords)
+        if frecords:
+            prev_seq = frecords[-1]["seq"]
+        if valid < len(raw):
+            if is_active:
                 report.truncated_bytes = len(raw) - valid
 
                 def _truncate() -> None:
@@ -121,9 +148,23 @@ async def recover_state(state, snapshot_path: str, wal_path: str) -> RecoveryRep
                 await asyncio.to_thread(_truncate)
                 log.warning(
                     "torn WAL tail: dropped %d trailing bytes of %s after "
-                    "seq %d (crash mid-append; acknowledged records are intact)",
+                    "seq %d (crash mid-append; acknowledged records are "
+                    "intact)",
                     report.truncated_bytes, wal_path, records[-1]["seq"],
                 )
+            else:
+                # sealed segments are fsynced before their rename — a bad
+                # interior is disk corruption: keep the valid prefix,
+                # quarantine the file, refuse everything after the gap
+                dst = quarantine_file(fpath, int(time.time()))
+                report.wal_quarantined = report.wal_quarantined or dst
+                log.error(
+                    "ERROR: sealed WAL segment %s is corrupt past a valid "
+                    "prefix; quarantined to %s (later log files will be "
+                    "set aside — recover them manually if needed)",
+                    fpath, dst,
+                )
+                poisoned = True
     last_seq = records[-1]["seq"] if records else 0
 
     # 2. Snapshot: corrupt files quarantine and boot, never crash-loop.

@@ -37,8 +37,23 @@ file.  The file is created 0600 and re-chmodded defensively: session
 records hold live bearer tokens, the same protection requirement as the
 snapshot.
 
+Segmented mode (``wal_segment_bytes > 0``): the active file is sealed
+into immutable ``<path>.<first_seq>-<last_seq>.seg`` files (zero-padded,
+so lexicographic name order IS sequence order — the proof log's rotation
+discipline) once it outgrows the threshold, off the event loop (the seal
+runs inside :meth:`WriteAheadLog.sync` on the caller's worker thread).
+Compaction then **unlinks** fully-covered sealed segments instead of
+copying the surviving tail under the fd lock — the append stall stops
+scaling with tail size (the million-user cliff of ISSUE 14).  All byte
+offsets exposed by the class (``size``, ``read_from``, ``compact``,
+``truncate_to``) are *logical*: positions in the concatenation of sealed
+segments plus the active file, rebased by ``freed`` on compaction exactly
+as the single-file offsets always were, so the snapshot watermark and the
+replication shipper's acked-offset bookkeeping carry over unchanged.
+
 Deterministic crash points (``pre_append`` / ``mid_frame`` /
-``post_append_pre_fsync`` / ``pre_rename``) are consulted on a
+``post_append_pre_fsync`` / ``pre_rename``, plus ``pre_seal`` /
+``pre_unlink`` in segmented mode) are consulted on a
 :class:`~cpzk_tpu.resilience.faults.FaultPlan` passed as ``faults`` —
 each raises :class:`CrashPoint` at exactly the file state a process
 death at that instruction would leave, so the recovery tests assert
@@ -49,6 +64,7 @@ from __future__ import annotations
 
 import json
 import os
+import re
 import struct
 import tempfile
 import threading
@@ -72,6 +88,8 @@ WAL_CRASH_POINTS = (
     "mid_frame",             # half the frame written: a torn tail on disk
     "post_append_pre_fsync",  # full frame written, never fsynced
     "pre_rename",            # compaction tmp written, rename never happened
+    "pre_seal",              # active file fsynced, seal rename never happened
+    "pre_unlink",            # covered segment still on disk after compaction
 )
 
 
@@ -145,6 +163,56 @@ def read_frames(path: str) -> tuple[list[dict], int, int]:
     return records, valid, len(raw)
 
 
+#: Sealed-segment name template: zero-padded first/last sequence numbers
+#: so lexicographic order equals sequence order (the proof log's exact
+#: rotation discipline — ``cpzk_tpu/audit/log.py``).
+_SEG_WIDTH = 12
+_SEG_RE = re.compile(r"\.(\d{12})-(\d{12})\.seg$")
+
+
+def wal_segment_name(path: str, first_seq: int, last_seq: int) -> str:
+    return (
+        f"{path}.{first_seq:0{_SEG_WIDTH}d}-{last_seq:0{_SEG_WIDTH}d}.seg"
+    )
+
+
+def wal_segment_range(seg_path: str) -> tuple[int, int]:
+    """``(first_seq, last_seq)`` encoded in a sealed-segment name."""
+    m = _SEG_RE.search(seg_path)
+    if m is None:
+        raise ValueError(f"not a sealed WAL segment name: {seg_path!r}")
+    return int(m.group(1)), int(m.group(2))
+
+
+def wal_sealed_segments(path: str) -> list[str]:
+    """Sealed-segment files rotated out of the log at ``path``, sequence
+    order (their zero-padded names sort that way).  A directory scan, not
+    in-memory state — survives restarts, exactly like the proof log's."""
+    d = os.path.dirname(os.path.abspath(path)) or "."
+    base = os.path.basename(path)
+    try:
+        names = os.listdir(d)
+    except OSError:
+        return []
+    out = [
+        os.path.join(d, n)
+        for n in names
+        if n.startswith(base + ".") and _SEG_RE.search(n)
+    ]
+    out.sort()
+    return out
+
+
+def wal_files(path: str) -> list[str]:
+    """Every file holding this log's records, read order: sealed segments
+    (sequence order), then the active file when it exists — the set a
+    boot-time recovery must scan."""
+    out = wal_sealed_segments(path)
+    if os.path.exists(path):
+        out.append(path)
+    return out
+
+
 class WriteAheadLog:
     """Append-only framed-record log with a configurable fsync policy.
 
@@ -168,22 +236,65 @@ class WriteAheadLog:
         fsync_interval_ms: float = 50.0,
         start_seq: int = 0,
         faults=None,
+        segment_bytes: int = 0,
     ):
         if fsync not in ("always", "interval", "off"):
             raise ValueError(f"unknown WAL fsync policy: {fsync!r}")
+        if segment_bytes < 0:
+            raise ValueError("segment_bytes cannot be negative")
         self.path = path
         self.policy = fsync
         self.interval_s = fsync_interval_ms / 1000.0
         self.seq = start_seq
+        self.segment_bytes = segment_bytes
         self._faults = faults
         self._lock = threading.Lock()
+        # sealed segments already on disk (a restart, or a config change):
+        # (path, byte length) in sequence order.  Loaded regardless of
+        # segment_bytes so logical offsets stay correct after a downgrade.
+        self._segments: list[tuple[str, int]] = [
+            (seg, os.path.getsize(seg)) for seg in wal_sealed_segments(path)
+        ]
         self._fd: int | None = os.open(
             path, os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o600
         )
         os.chmod(path, 0o600)  # session records are bearer secrets
-        self.size = os.fstat(self._fd).st_size
+        self._active_size = os.fstat(self._fd).st_size
+        self.size = self._sealed_bytes() + self._active_size
+        # first sequence number in the active file (names the seal): from
+        # the file's own first frame when it has history, else the next
+        # append's number
+        self._active_first_seq = self.seq + 1
+        if self._active_size and (self.segment_bytes or self._segments):
+            # segmented mode needs the active file's own seq span (it
+            # names the next seal); legacy mode keeps the caller's
+            # start_seq untouched, exactly as before
+            try:
+                records, _, _ = read_frames(path)
+                if records:
+                    self._active_first_seq = int(records[0]["seq"])
+                    self.seq = max(self.seq, int(records[-1]["seq"]))
+            except OSError:  # pragma: no cover - racing external rotation
+                pass
+        for seg, _bytes in self._segments:
+            try:
+                self.seq = max(self.seq, wal_segment_range(seg)[1])
+            except ValueError:  # pragma: no cover - name-filtered above
+                pass
+        self._rotate_due = False
         self._pending = 0  # appends since the last fsync
         self._last_fsync = time.monotonic()
+        self._export_segment_gauge()
+
+    def _sealed_bytes(self) -> int:
+        return sum(b for _, b in self._segments)
+
+    @property
+    def segment_count(self) -> int:
+        return len(self._segments)
+
+    def _export_segment_gauge(self) -> None:
+        metrics.gauge("state.wal.segments").set(float(len(self._segments)))
 
     # -- append / sync -------------------------------------------------------
 
@@ -207,13 +318,19 @@ class WriteAheadLog:
                 cut = max(1, len(frame) // 2)
                 os.write(self._fd, frame[:cut])
                 self.size += cut
+                self._active_size += cut
                 raise CrashPoint(f"mid_frame at seq {seq}")
             os.write(self._fd, frame)
             self.seq = seq
             self.size += len(frame)
+            self._active_size += len(frame)
             self._pending += 1
             metrics.counter("state.wal.appends").inc()
             metrics.counter("state.wal.bytes").inc(len(frame))
+            if self.segment_bytes and self._active_size >= self.segment_bytes:
+                # sealed off the event loop: sync() (always run on a
+                # worker thread by callers) performs the rotation
+                self._rotate_due = True
             if self._crash("post_append_pre_fsync"):
                 raise CrashPoint(f"post_append_pre_fsync at seq {seq}")
             return seq
@@ -237,13 +354,19 @@ class WriteAheadLog:
             os.write(self._fd, frames)
             self.seq = last_seq
             self.size += len(frames)
+            self._active_size += len(frames)
             self._pending += 1
             metrics.counter("state.wal.appends").inc()
             metrics.counter("state.wal.bytes").inc(len(frames))
+            if self.segment_bytes and self._active_size >= self.segment_bytes:
+                self._rotate_due = True
 
     def needs_sync(self) -> bool:
-        """Whether :meth:`sync` would fsync right now under the policy —
-        lets the async caller skip the worker-thread hop entirely."""
+        """Whether :meth:`sync` would do work right now — an fsync the
+        policy wants, or a due segment seal — so the async caller can
+        skip the worker-thread hop entirely otherwise."""
+        if self._rotate_due:
+            return True
         if self._pending == 0 or self.policy == "off":
             return False
         if self.policy == "always":
@@ -252,8 +375,13 @@ class WriteAheadLog:
 
     def sync(self, force: bool = False) -> bool:
         """Fsync pending appends per the policy (``force`` overrides it);
-        returns whether an fsync happened."""
+        returns whether an fsync happened.  In segmented mode a due seal
+        happens here too — callers always run :meth:`sync` on a worker
+        thread, so the seal's fsync + rename never stall the event loop."""
         with self._lock:
+            if self._fd is not None and self._rotate_due:
+                self._seal_active_locked()
+                return True
             if self._fd is None or self._pending == 0:
                 return False
             if not force:
@@ -279,22 +407,130 @@ class WriteAheadLog:
     def pending(self) -> int:
         return self._pending
 
+    def _seal_active_locked(self) -> None:
+        """Rotate the active file into an immutable sealed segment:
+        fsync (a sealed segment is durable by definition), atomic rename
+        to ``<path>.<first>-<last>.seg``, reopen a fresh active file.
+        Caller holds ``_lock`` and runs on a worker thread."""
+        assert self._fd is not None
+        self._rotate_due = False
+        if self._active_size == 0 or self.seq < self._active_first_seq:
+            return  # nothing to seal (raced a compaction that truncated)
+        os.fsync(self._fd)
+        if self._crash("pre_seal"):
+            # the process dies with the active file fsynced but the
+            # rename not done: recovery sees the same records, unsealed
+            raise CrashPoint(
+                f"pre_seal of segments {self._active_first_seq}-{self.seq}"
+            )
+        os.close(self._fd)
+        self._fd = None
+        sealed = wal_segment_name(
+            self.path, self._active_first_seq, self.seq
+        )
+        os.replace(self.path, sealed)
+        self._segments.append((sealed, self._active_size))
+        self._fd = os.open(
+            self.path, os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o600
+        )
+        os.chmod(self.path, 0o600)
+        self._active_size = 0
+        self._active_first_seq = self.seq + 1
+        self._pending = 0
+        self._last_fsync = time.monotonic()
+        metrics.counter("state.wal.rotations").inc()
+        self._export_segment_gauge()
+
+    def read_from(self, offset: int = 0) -> bytes:
+        """Every log byte at or past the *logical* ``offset`` — the
+        concatenation of sealed segments plus the active file.  The read
+        seam the replication shipper tails and promotion replays through;
+        single-file logs read exactly as before.  Runs under the fd lock
+        (callers are worker threads); a torn concurrent append surfaces
+        as a torn tail, which ``iter_frames`` already refuses to parse."""
+        with self._lock:
+            out = bytearray()
+            pos = 0
+            for seg, nbytes in self._segments:
+                end = pos + nbytes
+                if offset < end:
+                    try:
+                        with open(seg, "rb") as f:
+                            f.seek(max(0, offset - pos))
+                            out += f.read()
+                    except FileNotFoundError:  # pragma: no cover - racing unlink
+                        pass
+                pos = end
+            try:
+                with open(self.path, "rb") as f:
+                    f.seek(max(0, offset - pos))
+                    out += f.read()
+            except FileNotFoundError:  # pragma: no cover - closed + unlinked
+                pass
+            return bytes(out)
+
     # -- compaction ----------------------------------------------------------
 
     def compact(self, upto_offset: int) -> int:
-        """Drop the byte prefix a snapshot now covers: copy ``[upto_offset,
-        EOF)`` to a 0600 tmp file, fsync it, and atomically rename it over
-        the log.  Returns bytes freed.  Runs under the fd lock, so
-        concurrent appends briefly queue; the copied tail is bounded by the
-        compaction threshold, keeping the stall small.  A crash before the
-        rename (``pre_rename`` crash point, or a real one) leaves the old
-        log fully intact — compaction is all-or-nothing."""
+        """Drop the byte prefix a snapshot now covers; returns bytes
+        freed.  Offsets are logical (see the module docstring); callers
+        rebase their own offsets by the return value exactly as before.
+
+        **Segmented mode** (``segment_bytes > 0``): fully-covered sealed
+        segments are simply **unlinked** — no copy, no stall proportional
+        to the surviving tail (the ``pre_unlink`` crash point stands in
+        for dying between unlinks: leftover covered segments replay
+        idempotently at the next boot).  A covered prefix that ends
+        inside the active file waits for that file's own seal, except
+        when the WHOLE log is covered, where the active file is
+        ftruncated to zero in place (O(1)).  **Single-file mode**
+        (``segment_bytes == 0``, no sealed segments on disk): the
+        historical copy-and-rename path, byte-for-byte, including the
+        ``pre_rename`` all-or-nothing crash point."""
         with self._lock:
             if self._fd is None:
                 raise OSError("write-ahead log is closed")
             upto = max(0, min(upto_offset, self.size))
             if upto == 0:
                 return 0
+            freed = 0
+            # 1) unlink sealed segments the covered prefix fully spans
+            while self._segments and self._segments[0][1] <= upto:
+                seg, nbytes = self._segments[0]
+                if self._crash("pre_unlink"):
+                    raise CrashPoint(f"pre_unlink of {os.path.basename(seg)}")
+                try:
+                    os.unlink(seg)
+                except FileNotFoundError:  # pragma: no cover - already gone
+                    pass
+                self._segments.pop(0)
+                upto -= nbytes
+                freed += nbytes
+                self.size -= nbytes
+            if self._segments:
+                # the boundary lies inside a sealed segment: it stays
+                # until a later snapshot covers it whole (no partial
+                # rewrites of immutable files)
+                self._export_segment_gauge()
+                return freed
+            # 2) the remaining covered prefix lies inside the active file
+            if upto <= 0:
+                self._export_segment_gauge()
+                return freed
+            if self.segment_bytes:
+                if upto >= self._active_size:
+                    # whole log covered: empty the active file in place
+                    os.ftruncate(self._fd, 0)
+                    freed += self._active_size
+                    self.size -= self._active_size
+                    self._active_size = 0
+                    self._active_first_seq = self.seq + 1
+                    self._pending = 0
+                    self._rotate_due = False
+                # else: wait for the seal — never copy under the fd lock
+                self._export_segment_gauge()
+                return freed
+            # single-file mode: the historical copy-compaction
             with open(self.path, "rb") as f:
                 f.seek(upto)
                 tail = f.read()
@@ -319,29 +555,85 @@ class WriteAheadLog:
             self._fd = os.open(
                 self.path, os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o600
             )
-            freed = self.size - len(tail)
-            self.size = len(tail)
+            freed += self._active_size - len(tail)
+            self.size -= self._active_size - len(tail)
+            self._active_size = len(tail)
             self._pending = 0  # the tmp copy was fsynced before the rename
             return freed
 
     def truncate_to(self, valid_bytes: int) -> int:
-        """Drop everything past ``valid_bytes`` (the torn tail a standby
-        found at promotion time); returns bytes dropped.  The log's
-        bookkeeping stays consistent — callers pass the valid-prefix
-        boundary ``iter_frames`` reported."""
+        """Drop everything past the *logical* offset ``valid_bytes`` (the
+        torn tail a standby found at promotion time); returns bytes
+        dropped.  Callers pass the valid-prefix boundary ``iter_frames``
+        reported over :meth:`read_from` output.  Sealed segments are
+        fsynced before their rename, so the boundary normally lands in
+        the active file; a boundary inside a sealed segment (disk
+        corruption) truncates that segment in place, renames it to its
+        corrected seq range, and drops everything after it."""
         with self._lock:
             if self._fd is None:
                 raise OSError("write-ahead log is closed")
             valid = max(0, min(valid_bytes, self.size))
             dropped = self.size - valid
-            if dropped:
+            if not dropped:
+                return 0
+            active_start = self.size - self._active_size
+            if valid >= active_start:
+                # the normal case: the torn tail is in the active file
+                keep = valid - active_start
                 fd = os.open(self.path, os.O_WRONLY)
                 try:
-                    os.ftruncate(fd, valid)
+                    os.ftruncate(fd, keep)
                     os.fsync(fd)
                 finally:
                     os.close(fd)
+                self._active_size = keep
                 self.size = valid
+                return dropped
+            # corruption inside a sealed segment: drop the active file
+            # and every later segment, cut the straddled one in place
+            fd = os.open(self.path, os.O_WRONLY)
+            try:
+                os.ftruncate(fd, 0)
+                os.fsync(fd)
+            finally:
+                os.close(fd)
+            self._active_size = 0
+            pos = 0
+            keep_segments: list[tuple[str, int]] = []
+            for seg, nbytes in self._segments:
+                end = pos + nbytes
+                if end <= valid:
+                    keep_segments.append((seg, nbytes))
+                elif pos < valid:
+                    # straddled: truncate, rescan, rename to the real range
+                    cut = valid - pos
+                    sfd = os.open(seg, os.O_WRONLY)
+                    try:
+                        os.ftruncate(sfd, cut)
+                        os.fsync(sfd)
+                    finally:
+                        os.close(sfd)
+                    records, _, _ = read_frames(seg)
+                    if records:
+                        fixed = wal_segment_name(
+                            self.path, int(records[0]["seq"]),
+                            int(records[-1]["seq"]),
+                        )
+                        os.replace(seg, fixed)
+                        keep_segments.append((fixed, cut))
+                    else:
+                        os.unlink(seg)
+                else:
+                    try:
+                        os.unlink(seg)
+                    except FileNotFoundError:  # pragma: no cover
+                        pass
+                pos = end
+            self._segments = keep_segments
+            self.size = self._sealed_bytes()
+            self._active_first_seq = self.seq + 1
+            self._export_segment_gauge()
             return dropped
 
     # -- lifecycle -----------------------------------------------------------

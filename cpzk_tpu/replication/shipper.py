@@ -196,13 +196,10 @@ class SegmentShipper:
             return
 
         offset = self.acked_offset
-
-        def _read() -> bytes:
-            with open(wal.path, "rb") as f:
-                f.seek(offset)
-                return f.read()
-
-        raw = await asyncio.to_thread(_read)
+        # logical-offset read across sealed segments + the active file:
+        # the shipper keeps tailing straight through a rotation, and
+        # compaction rebases acked_offset via note_compacted as before
+        raw = await asyncio.to_thread(wal.read_from, offset)
         records, valid = iter_frames(raw)
         new = [r for r in records if r["seq"] > self.acked_seq]
         # bytes of already-acknowledged records in the chunk (a restarted

@@ -494,13 +494,10 @@ class StandbyReplica:
             await asyncio.to_thread(wal.sync, True)
             # finish replay: anything durable in the local log beyond the
             # applied watermark (a crash between persist and commit), and
-            # truncate a torn tail a hard standby death left behind
-            def _read():
-                with open(wal.path, "rb") as f:
-                    raw = f.read()
-                return raw
-
-            raw = await asyncio.to_thread(_read)
+            # truncate a torn tail a hard standby death left behind —
+            # read_from(0) spans sealed segments + the active file, so a
+            # rotated standby WAL promotes exactly like a single file
+            raw = await asyncio.to_thread(wal.read_from, 0)
             records, valid = iter_frames(raw)
             truncated = 0
             if valid < len(raw):

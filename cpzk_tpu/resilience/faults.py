@@ -138,7 +138,9 @@ class FaultPlan:
         """Schedule a :class:`CrashPoint` at the ``occurrence``-th visit of
         a WAL crash site (``pre_append`` / ``mid_frame`` /
         ``post_append_pre_fsync`` count once per append, in that order;
-        ``pre_rename`` once per compaction) or a replication site
+        ``pre_rename`` once per single-file compaction, ``pre_seal`` once
+        per segment seal, ``pre_unlink`` once per covered-segment unlink
+        under segmented compaction) or a replication site
         (``pre_ship`` / ``mid_segment`` once per shipped segment,
         ``pre_promote`` once per promotion attempt) — the deterministic
         stand-in for the process dying at exactly that instruction.  Pass

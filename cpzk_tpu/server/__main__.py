@@ -18,6 +18,7 @@ from __future__ import annotations
 import argparse
 import asyncio
 import contextlib
+import gc
 import logging
 import os
 import signal
@@ -28,7 +29,9 @@ from . import metrics
 from .config import RateLimiter, ServerConfig
 from .state import ServerState
 
-CLEANUP_INTERVAL_SECONDS = 60
+# sweep/checkpoint cadence; CPZK_CLEANUP_INTERVAL_S shortens it so a
+# bounded-duration soak run still observes checkpoints and sweeps
+CLEANUP_INTERVAL_SECONDS = float(os.environ.get("CPZK_CLEANUP_INTERVAL_S", 60))
 SUPERVISOR_BACKOFF_SECONDS = 5
 DRAIN_SECONDS = 2
 
@@ -224,6 +227,14 @@ async def cleanup_supervisor(
                 await durability.checkpoint()
             elif state_file:
                 await state.snapshot(state_file)
+            # freeze the surviving object graph out of the cyclic
+            # collector's gen-2 scan: at millions of registered users an
+            # automatic collection traverses every UserData/SessionData
+            # and stalls the event loop for ~a second.  The state graph
+            # is acyclic (refcounting frees removed entries regardless),
+            # so freezing after each checkpoint keeps the scanned set to
+            # recent allocations only.
+            gc.freeze()
 
     while not stop.is_set():
         try:
@@ -511,7 +522,12 @@ async def load_state(config: ServerConfig):
     it: the plain snapshot restore, where a corrupt snapshot quarantines
     with a loud ERROR and the server boots empty instead of crash-looping
     on every restart."""
-    state = ServerState(shards=config.replication.shards)
+    state = ServerState(
+        shards=config.replication.shards,
+        max_users=config.server.max_users,
+        max_challenges=config.server.max_challenges,
+        max_sessions=config.server.max_sessions,
+    )
     if config.durability.enabled:
         from ..durability import DurabilityManager
 
@@ -526,6 +542,10 @@ async def load_state(config: ServerConfig):
         # fold the replayed suffix into a fresh covering snapshot now:
         # bounds the next boot's replay and arms compaction
         await durability.checkpoint()
+        # a freshly-recovered million-user graph goes straight into the
+        # collector's frozen set (see cleanup_supervisor for why)
+        gc.collect()
+        gc.freeze()
         return state, durability
     if config.state_file and os.path.exists(config.state_file):
         try:

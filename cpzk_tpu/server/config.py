@@ -43,6 +43,12 @@ class ServerSettings:
                             # over a CRC-framed unix-socket seam — ingest
                             # scales with host cores the way the device
                             # plane scales with chips
+    # global state-capacity caps (reference-parity defaults; raise them
+    # for million-user deployments — the soak harness does).  Counters
+    # are maintained integers, so a large cap costs nothing per RPC.
+    max_users: int = 10_000
+    max_challenges: int = 50_000
+    max_sessions: int = 100_000
 
 
 @dataclass
@@ -221,6 +227,14 @@ class DurabilitySettings:
     compact_bytes: int = 1_048_576   # compact the WAL once it outgrows
                                      # this after a covering snapshot;
                                      # 0 = compact on every snapshot
+    wal_segment_bytes: int = 0       # rotate the WAL into sealed
+                                     # <wal>.<first>-<last>.seg files at
+                                     # about this size (0 = single-file
+                                     # log, copy-compaction).  Sealed
+                                     # segments make compaction an
+                                     # unlink of fully-covered files —
+                                     # append stalls stop scaling with
+                                     # the surviving tail
 
 
 @dataclass
@@ -457,6 +471,12 @@ class ServerConfig:
             self.server.wire = v.lower()
         if (v := get("INGEST_SHARDS")) is not None:
             self.server.ingest_shards = int(v)
+        if (v := get("MAX_USERS")) is not None:
+            self.server.max_users = int(v)
+        if (v := get("MAX_CHALLENGES")) is not None:
+            self.server.max_challenges = int(v)
+        if (v := get("MAX_SESSIONS")) is not None:
+            self.server.max_sessions = int(v)
         # short aliases mirror the reference's clap env names
         if (v := get_alias("RATE_LIMIT_REQUESTS_PER_MINUTE", "RATE_LIMIT")) is not None:
             self.rate_limit.requests_per_minute = int(v)
@@ -561,6 +581,8 @@ class ServerConfig:
             self.durability.fsync_interval_ms = float(v)
         if (v := get("DURABILITY_COMPACT_BYTES")) is not None:
             self.durability.compact_bytes = int(v)
+        if (v := get("DURABILITY_WAL_SEGMENT_BYTES")) is not None:
+            self.durability.wal_segment_bytes = int(v)
         # replication knobs (WAL segment shipping + lease-based promotion)
         if (v := get("REPLICATION_ENABLED")) is not None:
             self.replication.enabled = v.lower() in ("1", "true", "yes", "on")
@@ -687,6 +709,14 @@ class ServerConfig:
                 "server.ingest_shards must be in [1, 64] (1 = the "
                 "in-process listener)"
             )
+        if min(
+            self.server.max_users,
+            self.server.max_challenges,
+            self.server.max_sessions,
+        ) < 1:
+            raise ValueError(
+                "server.max_users/max_challenges/max_sessions must be >= 1"
+            )
         if (
             self.server.ingest_shards > 1
             and self.replication.enabled
@@ -775,6 +805,11 @@ class ServerConfig:
             raise ValueError("durability.fsync_interval_ms must be positive")
         if self.durability.compact_bytes < 0:
             raise ValueError("durability.compact_bytes cannot be negative")
+        if self.durability.wal_segment_bytes < 0:
+            raise ValueError(
+                "durability.wal_segment_bytes cannot be negative "
+                "(0 = single-file log)"
+            )
         if self.durability.enabled and not self.state_file:
             raise ValueError(
                 "durability.enabled requires state_file (the snapshot path "

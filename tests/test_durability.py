@@ -764,3 +764,192 @@ def test_sigkill_mid_traffic_recovers_every_acknowledged_write(tmp_path):
             assert re.fullmatch(r"user-\d{4}", uid)
 
     run(reboot())
+
+
+# --- segmented WAL (ISSUE 14 tentpole d) -------------------------------------
+
+
+class TestSegmentedWal:
+    def test_rotation_recovery_compaction_roundtrip(self, tmp_path):
+        """Appends rotate into sealed segments, a crash-reboot replays the
+        whole segmented history, a covering checkpoint compacts it to
+        nothing (unlink, not copy), and graceful close leaves a log the
+        next boot replays nothing from."""
+
+        async def main():
+            state, mgr = make_manager(
+                tmp_path, wal_segment_bytes=500, compact_bytes=0
+            )
+            await mgr.recover()
+            stmts = {i: make_statement() for i in range(40)}
+            for i in range(40):
+                await register(state, i, stmts[i])
+            assert mgr.wal.segment_count > 3
+            from cpzk_tpu.durability.wal import wal_sealed_segments
+
+            names = [
+                os.path.basename(p)
+                for p in wal_sealed_segments(mgr.wal_path)
+            ]
+            assert names == sorted(names)  # name order IS seq order
+
+            # crash (no close, no snapshot): reboot replays across segments
+            state2, mgr2 = make_manager(
+                tmp_path, wal_segment_bytes=500, compact_bytes=0
+            )
+            report = await mgr2.recover()
+            assert report.replayed == 40
+            assert await state2.user_count() == 40
+            for i in (0, 17, 39):
+                u = await state2.get_user(f"u{i}")
+                assert u is not None and u.statement == stmts[i]
+
+            # covering checkpoint: everything compacts away by unlink
+            await mgr2.checkpoint()
+            assert mgr2.wal.size == 0 and mgr2.wal.segment_count == 0
+            assert wal_sealed_segments(mgr2.wal_path) == []
+
+            await register(state2, 40)
+            await mgr2.close()
+            state3, mgr3 = make_manager(tmp_path, wal_segment_bytes=500)
+            report3 = await mgr3.recover()
+            assert await state3.user_count() == 41
+            assert report3.replayed == 0
+            mgr3.wal.close()
+
+        run(main())
+
+    def test_segmented_compaction_never_copies(self, tmp_path, monkeypatch):
+        """The cliff this mode removes: compaction must not copy the
+        surviving tail under the fd lock.  Spy: the copy path's tempfile
+        is never created while sealed segments are being unlinked."""
+
+        async def main():
+            import cpzk_tpu.durability.wal as wal_mod
+
+            copies = []
+            real_mkstemp = wal_mod.tempfile.mkstemp
+
+            def spy_mkstemp(*args, **kwargs):
+                if ".compact." in kwargs.get("prefix", ""):
+                    copies.append(kwargs["prefix"])
+                return real_mkstemp(*args, **kwargs)
+
+            monkeypatch.setattr(wal_mod.tempfile, "mkstemp", spy_mkstemp)
+            state, mgr = make_manager(
+                tmp_path, wal_segment_bytes=400, compact_bytes=0
+            )
+            await mgr.recover()
+            for i in range(30):
+                await register(state, i)
+            segments_before = mgr.wal.segment_count
+            assert segments_before > 2
+            await mgr.checkpoint()
+            assert mgr.wal.segment_count < segments_before
+            assert copies == [], "segmented compaction copied the tail"
+            mgr.wal.close()
+
+        run(main())
+
+    @pytest.mark.parametrize("point", ["pre_seal", "pre_unlink"])
+    def test_segment_crash_points_recover_exactly(self, tmp_path, point):
+        """FaultPlan matrix extension: dying at the seal rename or
+        between compaction unlinks loses nothing — recovery replays the
+        identical acknowledged prefix either way."""
+
+        async def main():
+            plan = FaultPlan().crash_on(point, occurrence=0)
+            state, mgr = make_manager(
+                tmp_path, plan=plan, wal_segment_bytes=400, compact_bytes=0
+            )
+            await mgr.recover()
+            crashed = False
+            for i in range(30):
+                try:
+                    await register(state, i)
+                except CrashPoint:
+                    crashed = True
+                    break
+            acked = 0
+            for i in range(30):
+                if (await state.get_user(f"u{i}")) is not None:
+                    acked += 1
+            if point == "pre_unlink":
+                assert not crashed
+                with pytest.raises(CrashPoint):
+                    await mgr.checkpoint()  # dies between unlinks
+            else:
+                assert crashed  # the seal happens on the append's sync
+
+            # reboot: exactly the acknowledged registrations, regardless
+            # of which file the crash left half-rotated/half-compacted
+            state2, mgr2 = make_manager(
+                tmp_path, wal_segment_bytes=400, compact_bytes=0
+            )
+            await mgr2.recover()
+            assert await state2.user_count() == acked
+            for i in range(acked):
+                assert await state2.get_user(f"u{i}") is not None
+            # and the log keeps working: append + clean reboot sees it
+            await register(state2, 90)
+            await mgr2.checkpoint()
+            state3, mgr3 = make_manager(
+                tmp_path, wal_segment_bytes=400, compact_bytes=0
+            )
+            await mgr3.recover()
+            assert await state3.get_user("u90") is not None
+            mgr3.wal.close()
+            mgr2.wal.close()
+
+        run(main())
+
+    def test_corrupt_sealed_segment_quarantines_suffix(self, tmp_path):
+        """Sealed segments are fsynced before their rename, so interior
+        corruption is a disk fault: recovery keeps the clean prefix and
+        quarantines the corrupt file plus everything after the gap."""
+
+        async def main():
+            state, mgr = make_manager(
+                tmp_path, wal_segment_bytes=400, compact_bytes=10**9
+            )
+            await mgr.recover()
+            for i in range(30):
+                await register(state, i)
+            mgr.wal.close()
+            from cpzk_tpu.durability.wal import wal_sealed_segments
+
+            segs = wal_sealed_segments(mgr.wal_path)
+            assert len(segs) >= 3
+            with open(segs[1], "r+b") as f:  # clobber the SECOND segment
+                f.write(b"\xff" * 32)
+
+            state2, mgr2 = make_manager(tmp_path, wal_segment_bytes=400)
+            report = await mgr2.recover()
+            assert report.wal_quarantined is not None
+            # the first segment's records survived; the poisoned suffix
+            # (segment 2 onward) is quarantined, not applied
+            count = await state2.user_count()
+            assert 0 < count < 30
+            remaining = wal_sealed_segments(mgr2.wal_path)
+            assert all(".corrupt-" not in p for p in remaining)
+            mgr2.wal.close()
+
+        run(main())
+
+    def test_wal_segment_bytes_config_layering(self, tmp_path, monkeypatch):
+        monkeypatch.chdir(tmp_path)
+        (tmp_path / "server.toml").write_text(
+            "[durability]\nenabled = true\nwal_segment_bytes = 4096\n"
+        )
+        monkeypatch.setenv("SERVER_CONFIG_PATH", str(tmp_path / "server.toml"))
+        monkeypatch.setenv("SERVER_STATE_FILE", str(tmp_path / "s.json"))
+        cfg = ServerConfig.from_env()
+        assert cfg.durability.wal_segment_bytes == 4096
+        cfg.validate()
+        monkeypatch.setenv("SERVER_DURABILITY_WAL_SEGMENT_BYTES", "8192")
+        cfg = ServerConfig.from_env()
+        assert cfg.durability.wal_segment_bytes == 8192
+        bad = ServerConfig()
+        bad.durability.wal_segment_bytes = -1
+        with pytest.raises(ValueError, match="wal_segment_bytes"):
+            bad.validate()

@@ -82,13 +82,15 @@ def make_records(n, start_seq=1, rtype="register_user"):
 
 async def make_pair(tmp_path, lease_ms=400.0, renew_ms=40.0, mode="sync",
                     segment_bytes=65536, standby_faults=None,
-                    primary_faults=None, auto_promote=True):
+                    primary_faults=None, auto_promote=True,
+                    wal_segment_bytes=0):
     """(primary side, standby side) wired over a real gRPC link."""
     from cpzk_tpu.server.service import serve
 
     sstate = ServerState()
     smgr = DurabilityManager(
-        sstate, DurabilitySettings(enabled=True),
+        sstate,
+        DurabilitySettings(enabled=True, wal_segment_bytes=wal_segment_bytes),
         str(tmp_path / "standby.json"), faults=standby_faults,
     )
     await smgr.recover()
@@ -104,7 +106,8 @@ async def make_pair(tmp_path, lease_ms=400.0, renew_ms=40.0, mode="sync",
 
     pstate = ServerState()
     pmgr = DurabilityManager(
-        pstate, DurabilitySettings(enabled=True),
+        pstate,
+        DurabilitySettings(enabled=True, wal_segment_bytes=wal_segment_bytes),
         str(tmp_path / "primary.json"), faults=primary_faults,
     )
     await pmgr.recover()
@@ -688,3 +691,115 @@ def test_shipped_frames_are_canonical():
     assert valid == len(frames)
     again = b"".join(encode_record(r) for r in parsed)
     assert again == frames
+
+
+# --- segmented WAL under replication (ISSUE 14) ------------------------------
+
+
+class TestSegmentedWalReplication:
+    def test_shipping_promotion_and_clamp_across_segment_boundaries(
+        self, tmp_path
+    ):
+        """A rotating primary WAL ships transparently: the shipper's
+        logical-offset tail spans sealed segments, the standby (itself
+        rotating) applies every record, the compaction clamp still never
+        drops unshipped bytes, and the promoted standby serves the full
+        history — the PR 8 contract, unchanged by rotation."""
+
+        async def main():
+            (pstate, pmgr, shipper), (sstate, smgr, replica, sserver, _p) = (
+                await make_pair(
+                    tmp_path, mode="sync", wal_segment_bytes=600,
+                    segment_bytes=700, auto_promote=False,
+                )
+            )
+            try:
+                stmts = {}
+                for i in range(30):
+                    stmts[i] = make_statement()
+                    await pstate.register_user(
+                        UserData(f"user-{i}", stmts[i], 1)
+                    )
+                # sync mode: every ack waited for standby apply
+                assert shipper.acked_seq == pmgr.wal.seq
+                # rotation actually happened on both sides
+                await asyncio.to_thread(pmgr.wal.sync, True)
+                await asyncio.to_thread(smgr.wal.sync, True)
+                assert pmgr.wal.segment_count > 0
+                assert await sstate.user_count() == 30
+
+                # compaction: a covering snapshot may unlink only what is
+                # BOTH covered and shipped; everything acked here, so the
+                # checkpoint unlinks the sealed prefix with no copy
+                pmgr.settings.compact_bytes = 0  # compact on this snapshot
+                size_before = pmgr.wal.size
+                await pmgr.checkpoint()
+                assert pmgr.wal.size < size_before
+                assert shipper.safe_compact_offset() <= pmgr.wal.size
+
+                # more writes after compaction keep shipping
+                await pstate.register_user(
+                    UserData("user-99", make_statement(), 1)
+                )
+                await wait_for(lambda: replica.applied_seq == pmgr.wal.seq)
+
+                # promotion over a rotated standby WAL
+                await shipper.kill()
+                report = await replica.promote(reason="test")
+                assert report["promoted"]
+                assert await sstate.user_count() == 31
+                for i in (0, 7, 29):
+                    u = await sstate.get_user(f"user-{i}")
+                    assert u is not None and u.statement == stmts[i]
+                # the promoted node keeps journaling into the same log
+                await sstate.register_user(
+                    UserData("post-promote", make_statement(), 1)
+                )
+            finally:
+                await shipper.kill()
+                await replica.stop()
+                await sserver.stop(None)
+                pmgr.wal.close()
+                smgr.wal.close()
+
+        run(main())
+
+    def test_standby_reboot_recovers_rotated_wal(self, tmp_path):
+        """A standby that crashed with sealed segments on disk recovers
+        through ordinary durability recovery (the segment scan) and
+        resumes from the right applied_seq."""
+
+        async def main():
+            (pstate, pmgr, shipper), (sstate, smgr, replica, sserver, _p) = (
+                await make_pair(
+                    tmp_path, mode="sync", wal_segment_bytes=500,
+                    auto_promote=False,
+                )
+            )
+            try:
+                for i in range(20):
+                    await pstate.register_user(
+                        UserData(f"user-{i}", make_statement(), 1)
+                    )
+                applied = replica.applied_seq
+                assert applied == pmgr.wal.seq
+            finally:
+                await shipper.kill()
+                await replica.stop()
+                await sserver.stop(None)
+                pmgr.wal.close()
+                smgr.wal.close()
+
+            # standby "reboot": fresh state recovered from its own files
+            sstate2 = ServerState()
+            smgr2 = DurabilityManager(
+                sstate2,
+                DurabilitySettings(enabled=True, wal_segment_bytes=500),
+                str(tmp_path / "standby.json"),
+            )
+            report = await smgr2.recover()
+            assert report.next_seq == applied
+            assert await sstate2.user_count() == 20
+            smgr2.wal.close()
+
+        run(main())
