@@ -18,11 +18,21 @@ Rule pack (see docs/security.md "Mechanically enforced invariants"):
 - **ASYNC-002** — spawned task handles must be retained
 - **GRPC-001** — RESOURCE_EXHAUSTED aborts route through ``_abort_exhausted``
 - **JAX-001** — jit purity + real ``static_argnames``/``static_argnums``
-- **WAIVER-001** / **PARSE-001** — waivers need reasons; files must parse
+- **THREAD-001** — asyncio objects untouched from thread/process context
+  except via ``loop.call_soon_threadsafe`` (execution-context inference)
+- **FUNNEL-001** — ``ServerState`` registry mutations ride the
+  ``_*_insert``/``_*_remove`` funnels (wheel/counter consistency)
+- **PROC-001** — spawn ``Process`` targets are module-level with
+  picklable, spawn-safe args
+- **FRAME-001** — length+CRC framing only via the shared WAL helpers
+- **WAIVER-001** / **WAIVER-002** / **PARSE-001** — waivers need reasons
+  and must stay live; files must parse
 
 Run: ``python -m cpzk_tpu.analysis cpzk_tpu/`` (``--json`` for the
-machine-readable report).  Waive a finding inline with
-``# cpzk-lint: disable=RULE-ID -- <reason>`` (the reason is mandatory).
+machine-readable report, ``--audit-waivers`` for every suppression with
+its liveness).  Waive a finding inline with
+``# cpzk-lint: disable=RULE-ID -- <reason>`` (the reason is mandatory,
+and the waiver must keep suppressing a live finding — WAIVER-002).
 """
 
 from __future__ import annotations

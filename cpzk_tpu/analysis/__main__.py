@@ -3,11 +3,13 @@
 Usage::
 
     python -m cpzk_tpu.analysis [paths ...] [--json] [--rules IDS]
-                                [--list-rules]
+                                [--list-rules] [--audit-waivers]
 
 Exit codes: 0 — clean; 1 — findings; 2 — usage or I/O error.  The JSON
 report schema is pinned by tests/test_static_analysis.py (CI uploads it
-as an artifact).
+as an artifact).  ``--audit-waivers`` lists every live waiver with its
+reason and liveness (a stale one — whose rule would no longer fire — is
+also a WAIVER-002 finding on a normal run).
 """
 
 from __future__ import annotations
@@ -38,6 +40,11 @@ def build_parser() -> argparse.ArgumentParser:
         "--list-rules", action="store_true",
         help="print the rule inventory and exit",
     )
+    p.add_argument(
+        "--audit-waivers", action="store_true",
+        help="list every live waiver (path:line, rules, reason, liveness) "
+        "instead of findings",
+    )
     return p
 
 
@@ -62,6 +69,15 @@ def main(argv: list[str] | None = None) -> int:
     except OSError as e:
         print(f"cpzk-lint: {e}", file=sys.stderr)
         return 2
+    if args.audit_waivers:
+        for w in report.waivers:
+            print(w.render())
+        stale = sum(1 for w in report.waivers if w.stale)
+        print(
+            f"cpzk-lint: {len(report.waivers)} waivers "
+            f"({stale} stale)"
+        )
+        return 1 if stale else 0
     if args.json:
         json.dump(report.to_dict(), sys.stdout, indent=2, sort_keys=True)
         print()

@@ -33,12 +33,24 @@ Three layers:
   f-strings, known scalar-ring helpers, and generic calls; a small
   sanitizer set (``hmac.compare_digest``, ``len`` …) declassifies.
 
-The analysis is intentionally intra-procedural and heuristic: it will
-not follow taint across call boundaries.  That is the right trade for a
-lint gate — rules fire on the patterns reviewers actually miss (a ``==``
-on secret bytes, a secret in an f-string log, a map mutation outside the
-state lock) with near-zero false positives on this codebase, enforced by
-the self-hosted zero-findings test in ``tests/test_static_analysis.py``.
+- **Execution contexts** — an interprocedural (per-module) pass
+  (:mod:`.contexts`) builds a call graph, seeds contexts at spawn sites
+  (``threading.Thread(target=)``, ``to_thread``, ``run_in_executor``,
+  ``multiprocessing`` spawn targets, loop-callback registrations), and
+  propagates them caller -> callee.  The context-sensitive rules
+  (THREAD-001, PROC-001) read the result through
+  :meth:`Module.func_info`; ASYNC-001 uses it to follow blocking calls
+  into nested helpers that provably run on the event loop.
+
+The taint analysis is intentionally intra-procedural and heuristic: it
+will not follow taint across call boundaries (the context pass is the
+one interprocedural layer, and it stops at the module boundary).  That
+is the right trade for a lint gate — rules fire on the patterns
+reviewers actually miss (a ``==`` on secret bytes, a secret in an
+f-string log, a map mutation outside the state lock, a Future settled
+from a lane thread) with near-zero false positives on this codebase,
+enforced by the self-hosted zero-findings test in
+``tests/test_static_analysis.py``.
 """
 
 from __future__ import annotations
@@ -47,6 +59,8 @@ import ast
 import os
 import re
 from dataclasses import dataclass, field
+
+from .contexts import ContextInference, FuncInfo
 
 # -- findings -----------------------------------------------------------------
 
@@ -94,9 +108,29 @@ class Waiver:
         return rule in self.rules and self.span[0] <= line <= self.span[1]
 
 
+def _comment_lines(source: str) -> dict[int, str] | None:
+    """Line -> text for every REAL comment token, via ``tokenize`` — a
+    waiver spelled inside a string literal or docstring (the docs quote
+    the syntax verbatim) must not register as a live waiver, which the
+    historical line-regex scan could not distinguish.  ``None`` when the
+    source does not tokenize (the regex fallback handles it)."""
+    import io
+    import tokenize
+
+    out: dict[int, str] = {}
+    try:
+        for tok in tokenize.generate_tokens(io.StringIO(source).readline):
+            if tok.type == tokenize.COMMENT:
+                out[tok.start[0]] = tok.string
+    except (tokenize.TokenError, IndentationError, SyntaxError, ValueError):
+        return None
+    return out
+
+
 def _parse_waivers(source: str, tree: ast.AST) -> list[Waiver]:
     """Extract waivers and resolve the line span each one covers."""
     lines = source.splitlines()
+    comments = _comment_lines(source)
     # def/class lines -> (start, end) body span, for whole-scope waivers
     scope_spans: dict[int, tuple[int, int]] = {}
     for node in ast.walk(tree):
@@ -109,7 +143,10 @@ def _parse_waivers(source: str, tree: ast.AST) -> list[Waiver]:
                 )
     out: list[Waiver] = []
     for i, text in enumerate(lines, start=1):
-        m = WAIVER_RE.search(text)
+        if comments is not None:
+            m = WAIVER_RE.search(comments.get(i, ""))
+        else:  # untokenizable source: the historical whole-line scan
+            m = WAIVER_RE.search(text)
         if m is None:
             continue
         rules = tuple(r.strip() for r in m.group(1).split(",") if r.strip())
@@ -456,6 +493,10 @@ class Module:
     tree: ast.Module
     waivers: list[Waiver] = field(default_factory=list)
     taint: dict[ast.AST, str] = field(default_factory=dict)
+    #: function node -> FuncInfo (execution contexts + call edges)
+    contexts: dict[ast.AST, FuncInfo] = field(default_factory=dict)
+    #: the inference pass itself (rules reuse its resolver/scope maps)
+    inference: ContextInference | None = None
 
     @property
     def plane(self) -> str:
@@ -483,6 +524,15 @@ class Module:
             best = _max_kind(best, self.taint.get(sub))
         return best
 
+    def func_info(self, node: ast.AST) -> FuncInfo | None:
+        """Context info for a function-def node (None for non-functions)."""
+        return self.contexts.get(node)
+
+    def func_contexts(self, node: ast.AST) -> frozenset[str]:
+        """Inferred execution contexts of a function-def node."""
+        info = self.contexts.get(node)
+        return frozenset(info.contexts) if info is not None else frozenset()
+
 
 def parse_module(source: str, path: str) -> Module | Finding:
     """Parse one source file; a syntax error becomes a PARSE-001 finding."""
@@ -494,6 +544,8 @@ def parse_module(source: str, path: str) -> Module | Finding:
     mod = Module(path=path, source=source, tree=tree)
     mod.waivers = _parse_waivers(source, tree)
     mod.taint = TaintPass().run(tree)
+    mod.inference = ContextInference(tree)
+    mod.contexts = mod.inference.run()
     return mod
 
 
@@ -567,23 +619,61 @@ def _load_rules() -> None:
 
 
 @dataclass
+class WaiverAudit:
+    """One live waiver's audit row (the ``--audit-waivers`` surface and
+    the ``waivers`` key of the JSON report)."""
+
+    path: str
+    line: int
+    rules: tuple[str, ...]
+    reason: str | None
+    waived: int                      # findings this waiver suppressed
+    stale: tuple[str, ...] = ()      # waived rule ids that never fired
+
+    def to_dict(self) -> dict:
+        return {
+            "path": self.path,
+            "line": self.line,
+            "rules": list(self.rules),
+            "reason": self.reason,
+            "waived": self.waived,
+            "stale": list(self.stale),
+        }
+
+    def render(self) -> str:
+        status = (
+            f"STALE: {','.join(self.stale)} would not fire"
+            if self.stale else f"active ({self.waived} waived)"
+        )
+        reason = self.reason or "<NO REASON>"
+        return (
+            f"{self.path}:{self.line}: disable={','.join(self.rules)} "
+            f"-- {reason} [{status}]"
+        )
+
+
+@dataclass
 class Report:
-    """One analysis run: active findings, waived findings, file count."""
+    """One analysis run: active findings, waived findings, file count,
+    and the waiver audit."""
 
     findings: list[Finding] = field(default_factory=list)
     waived: list[Finding] = field(default_factory=list)
+    waivers: list[WaiverAudit] = field(default_factory=list)
     files: int = 0
 
     def to_dict(self) -> dict:
         """The ``--json`` document.  Schema-stable: the drift-guard test in
-        tests/test_static_analysis.py pins these keys."""
+        tests/test_static_analysis.py pins these keys.  Version 2 added
+        the ``waivers`` audit list (WAIVER-002)."""
         return {
-            "schema_version": 1,
+            "schema_version": 2,
             "tool": "cpzk-lint",
             "rule_ids": all_rule_ids(),
             "files": self.files,
             "findings": [f.to_dict() for f in self.findings],
             "waived": [f.to_dict() for f in self.waived],
+            "waivers": [w.to_dict() for w in self.waivers],
             "summary": {
                 "findings": len(self.findings),
                 "waived": len(self.waived),
@@ -615,8 +705,10 @@ def _analyze(blobs: list[tuple[str, str]], rules: list[str] | None) -> Report:
         REGISTRY[r] for r in (rules if rules is not None else sorted(REGISTRY))
         if r in REGISTRY
     ]
+    active_ids = {r.id for r in active}
     report = Report(files=len(blobs))
     want_waiver_rule = rules is None or "WAIVER-001" in (rules or [])
+    want_stale_rule = rules is None or "WAIVER-002" in (rules or [])
     for source, path in blobs:
         mod = parse_module(source, path)
         if isinstance(mod, Finding):
@@ -631,22 +723,53 @@ def _analyze(blobs: list[tuple[str, str]], rules: list[str] | None) -> Report:
                     rule.id, mod.path, 1, 0,
                     f"internal rule error (treat as a finding): {e!r}",
                 ))
+        waived_count: dict[int, int] = {}
         for f in raw:
             waiver = next(
                 (w for w in mod.waivers if w.covers(f.rule, f.line)), None
             )
             if waiver is not None:
                 report.waived.append(f)
+                waived_count[waiver.line] = waived_count.get(waiver.line, 0) + 1
             else:
                 report.findings.append(f)
-        if want_waiver_rule:
-            for w in mod.waivers:
-                if w.reason is None:
-                    report.findings.append(Finding(
-                        "WAIVER-001", mod.path, w.line, 0,
-                        "waiver without a reason: write "
-                        "`# cpzk-lint: disable=RULE-ID -- <why>`",
-                    ))
+        for w in mod.waivers:
+            if want_waiver_rule and w.reason is None:
+                report.findings.append(Finding(
+                    "WAIVER-001", mod.path, w.line, 0,
+                    "waiver without a reason: write "
+                    "`# cpzk-lint: disable=RULE-ID -- <why>`",
+                ))
+            # WAIVER-002: a waived rule that no longer fires anywhere in
+            # the waiver's span is stale — the code it excused is gone (or
+            # changed), so the suppression must not outlive it.  Judged
+            # only for rules that actually ran this pass (a --rules filter
+            # that skipped the rule cannot call its waiver stale); a rule
+            # id no registered rule answers to can never fire and is
+            # always stale on a full run.
+            stale: list[str] = []
+            for rid in w.rules:
+                if rid in active_ids:
+                    if not any(
+                        f.rule == rid and w.span[0] <= f.line <= w.span[1]
+                        for f in raw
+                    ):
+                        stale.append(rid)
+                elif rules is None and rid not in REGISTRY:
+                    stale.append(rid)
+            if stale and want_stale_rule:
+                report.findings.append(Finding(
+                    "WAIVER-002", mod.path, w.line, 0,
+                    f"stale waiver: {', '.join(stale)} would not fire on "
+                    "the waived lines — delete the disable comment (or "
+                    "fix its rule id)",
+                ))
+            report.waivers.append(WaiverAudit(
+                path=mod.path, line=w.line, rules=w.rules, reason=w.reason,
+                waived=waived_count.get(w.line, 0),
+                stale=tuple(stale) if want_stale_rule else (),
+            ))
     report.findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
     report.waived.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    report.waivers.sort(key=lambda w: (w.path, w.line))
     return report

@@ -15,10 +15,14 @@ from ..engine import Module, Rule, register
 from . import (  # noqa: F401  (import-for-registration)
     async_discipline,
     constant_time,
+    framing,
     grpc_abort,
     jax_purity,
     leaks,
     locking,
+    process_spawn,
+    state_funnels,
+    thread_context,
 )
 
 
@@ -43,6 +47,22 @@ class WaiverRule(Rule):
         "`# cpzk-lint: disable=RULE-ID -- <why>` keeps every suppression "
         "justified in the diff; a bare disable is itself a finding and "
         "cannot be waived"
+    )
+
+    def check(self, module: Module):  # emitted by the engine's waiver scan
+        return []
+
+
+@register
+class StaleWaiverRule(Rule):
+    id = "WAIVER-002"
+    summary = "inline waivers must still suppress a live finding"
+    rationale = (
+        "a disable comment whose rule would no longer fire on the waived "
+        "lines excuses code that is gone — stale suppressions hide the "
+        "NEXT violation someone writes under them; delete the comment "
+        "(audit with --audit-waivers).  Like WAIVER-001, it cannot be "
+        "waived"
     )
 
     def check(self, module: Module):  # emitted by the engine's waiver scan

@@ -6,9 +6,12 @@ def`` stalls the whole event loop: every concurrent RPC, the batcher's
 dispatch window, and the health service all freeze behind it.  Blocking
 work belongs on a worker thread (``asyncio.to_thread`` /
 ``run_in_executor``); passing the callable there is fine — only direct
-*calls* are flagged, and nested sync ``def`` helpers (the standard
-ship-to-a-thread pattern, e.g. ``ServerState.snapshot``'s ``write()``)
-are skipped.
+*calls* are flagged.  Nested sync ``def`` helpers are judged by the
+execution-context inference (:mod:`cpzk_tpu.analysis.contexts`): one
+shipped to a thread (the standard pattern, e.g.
+``ServerState.snapshot``'s ``write()``) is exempt, while one the async
+body calls inline provably runs ON the loop and is scanned too — the
+helper indirection no longer hides the stall.
 
 ASYNC-002 — ``asyncio.create_task`` / ``ensure_future`` results that are
 immediately discarded are garbage-collectable mid-flight (the event loop
@@ -21,6 +24,7 @@ from __future__ import annotations
 
 import ast
 
+from ..contexts import EVENT_LOOP, PROCESS, THREAD
 from ..engine import Finding, Module, Rule, dotted_parts, register
 
 #: Planes whose async defs feed the serving event loop.  ``observability``
@@ -81,27 +85,36 @@ class BlockingInAsync(Rule):
     def _check_async_body(
         self, module: Module, func: ast.AsyncFunctionDef, out: list[Finding]
     ) -> None:
-        def scan(node: ast.AST) -> None:
+        def scan(node: ast.AST, where: str) -> None:
             for child in ast.iter_child_nodes(node):
-                # nested sync defs run on worker threads (to_thread
-                # targets); nested async defs are visited by the outer
-                # ast.walk pass in check()
-                if isinstance(
-                    child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
-                ):
+                if isinstance(child, (ast.AsyncFunctionDef, ast.Lambda)):
+                    # nested async defs are visited by the outer ast.walk
+                    # pass in check(); lambdas are callbacks, not calls
+                    continue
+                if isinstance(child, ast.FunctionDef):
+                    # nested sync def: exempt when it runs on a worker
+                    # thread (a to_thread / Thread target — the inference
+                    # seeded it THREAD), scanned when the async body
+                    # provably calls it inline on the loop
+                    ctx = module.func_contexts(child)
+                    if EVENT_LOOP in ctx and not ctx & {THREAD, PROCESS}:
+                        scan(
+                            child,
+                            f"`{child.name}` (called inline from `async "
+                            f"def {func.name}`)",
+                        )
                     continue
                 if isinstance(child, ast.Call):
                     reason = _blocking_reason(child)
                     if reason is not None:
                         out.append(self.finding(
                             module, child,
-                            f"blocking {reason} inside `async def "
-                            f"{func.name}` stalls the event loop; wrap it "
-                            "in asyncio.to_thread(...)",
+                            f"blocking {reason} inside {where} stalls the "
+                            "event loop; wrap it in asyncio.to_thread(...)",
                         ))
-                scan(child)
+                scan(child, where)
 
-        scan(func)
+        scan(func, f"`async def {func.name}`")
 
 
 @register

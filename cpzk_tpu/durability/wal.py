@@ -99,12 +99,36 @@ class CrashPoint(RuntimeError):
     does it for real)."""
 
 
+def frame_payload(payload: bytes) -> bytes:
+    """One framed blob: the shared ``length u32 | crc32 u32 | payload``
+    header over arbitrary bytes.  THE construction helper for every
+    plane speaking this discipline — the WAL and proof log (JSON records
+    via :func:`encode_record`), and the sharded-ingest unix pipe
+    (pickled request frames).  Hand-rolling the header elsewhere is a
+    FRAME-001 finding: one copy of the contract, zero drift."""
+    return _HEADER.pack(len(payload), zlib.crc32(payload) & 0xFFFFFFFF) + payload
+
+
+def unpack_frame_header(header: bytes) -> tuple[int, int]:
+    """``(length, crc32)`` from one ``HEADER_BYTES``-byte frame header —
+    the streaming read seam for consumers that cannot buffer the whole
+    log (the ingest pipe reads frame-by-frame off a socket;
+    :func:`iter_frames` is the whole-buffer scanner)."""
+    return _HEADER.unpack(header)
+
+
+def frame_crc_ok(payload: bytes, crc: int) -> bool:
+    """Whether ``payload`` matches the header's CRC (masked compare,
+    exactly as :func:`iter_frames` validates)."""
+    return zlib.crc32(payload) & 0xFFFFFFFF == int(crc) & 0xFFFFFFFF
+
+
 def encode_record(rec: dict) -> bytes:
     """One framed record: compact, key-sorted JSON behind length + CRC32."""
     payload = json.dumps(rec, separators=(",", ":"), sort_keys=True).encode()
     if len(payload) > MAX_FRAME_PAYLOAD:
         raise ValueError(f"WAL record exceeds {MAX_FRAME_PAYLOAD} bytes")
-    return _HEADER.pack(len(payload), zlib.crc32(payload) & 0xFFFFFFFF) + payload
+    return frame_payload(payload)
 
 
 def iter_frames(

@@ -44,20 +44,18 @@ import contextlib
 import logging
 import os
 import pickle
-import struct
 import tempfile
 import time
-import zlib
 
+from ..durability.wal import (
+    HEADER_BYTES,
+    frame_crc_ok,
+    frame_payload,
+    unpack_frame_header,
+)
 from . import metrics
 
 log = logging.getLogger("cpzk_tpu.server.ingest")
-
-#: Same header the write-ahead log frames with (length + CRC32, both
-#: u32 BE; ``wal.iter_frames`` discipline) — one framing vocabulary for
-#: every intra-fleet byte stream.
-_HEADER = struct.Struct(">II")
-HEADER_BYTES = _HEADER.size
 
 #: Frame payload cap: the largest legal gRPC request (4 MiB default
 #: receive limit) plus pickle overhead, with headroom.  A garbage
@@ -84,10 +82,13 @@ _WIRE_KINDS = {"CreateChallenge": 1, "VerifyProofBatch": 2,
 
 
 def pack_frame(payload: bytes) -> bytes:
-    """One CRC-framed message (the WAL's exact header discipline)."""
+    """One CRC-framed message — the WAL's exact header discipline, via
+    the shared :func:`~cpzk_tpu.durability.wal.frame_payload` helper (one
+    copy of the framing contract across WAL/proof-log/ingest; FRAME-001
+    pins it)."""
     if len(payload) > MAX_INGEST_FRAME:
         raise ValueError(f"ingest frame exceeds {MAX_INGEST_FRAME} bytes")
-    return _HEADER.pack(len(payload), zlib.crc32(payload) & 0xFFFFFFFF) + payload
+    return frame_payload(payload)
 
 
 async def read_frame(reader: asyncio.StreamReader) -> bytes | None:
@@ -99,11 +100,11 @@ async def read_frame(reader: asyncio.StreamReader) -> bytes | None:
         head = await reader.readexactly(HEADER_BYTES)
     except asyncio.IncompleteReadError:
         return None
-    length, crc = _HEADER.unpack(head)
+    length, crc = unpack_frame_header(head)
     if length == 0 or length > MAX_INGEST_FRAME:
         raise ValueError(f"ingest frame length {length} out of bounds")
     payload = await reader.readexactly(length)
-    if zlib.crc32(payload) & 0xFFFFFFFF != crc:
+    if not frame_crc_ok(payload, crc):
         raise ValueError("ingest frame CRC mismatch")
     return payload
 

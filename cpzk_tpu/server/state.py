@@ -454,21 +454,21 @@ class ServerState:
     # leak in one place: a remove that empties a user's session/challenge
     # list also deletes the dict entry, so the per-user index dicts no
     # longer grow with every user that ever held a session (ISSUE 14).
+    # Direct registry writes outside these six methods are a FUNNEL-001
+    # finding; lock discipline is the CALLER's obligation (LOCK-001 at
+    # the call sites — parameter-rooted mutations carry no waivers here).
 
-    # cpzk-lint: disable=LOCK-001 -- mutation funnel: every caller holds the owning shard's lock (or runs single-threaded replay/restore)
     def _user_insert(self, shard: StateShard, data: UserData) -> None:
         if data.user_id not in shard._users:
             self._n_users += 1
         shard._users[data.user_id] = data
 
-    # cpzk-lint: disable=LOCK-001 -- mutation funnel: every caller holds the owning shard's lock (or runs single-threaded replay/restore)
     def _user_remove(self, shard: StateShard, user_id: str) -> UserData | None:
         data = shard._users.pop(user_id, None)
         if data is not None:
             self._n_users -= 1
         return data
 
-    # cpzk-lint: disable=LOCK-001 -- mutation funnel: every caller holds the owning shard's lock (or runs single-threaded replay/restore)
     def _session_insert(self, shard: StateShard, data: SessionData) -> None:
         old = shard._sessions.get(data.token)
         if old is None:
@@ -482,7 +482,6 @@ class ServerState:
             _session_wheel_key(data), set()
         ).add(data.token)
 
-    # cpzk-lint: disable=LOCK-001 -- mutation funnel: every caller holds the owning shard's lock (or runs single-threaded replay/restore)
     def _session_remove(self, shard: StateShard, token: str) -> SessionData | None:
         data = shard._sessions.pop(token, None)
         if data is None:
@@ -499,7 +498,6 @@ class ServerState:
                 del shard._user_sessions[data.user_id]
         return data
 
-    # cpzk-lint: disable=LOCK-001 -- mutation funnel: every caller holds the owning shard's lock (or runs single-threaded replay/restore)
     def _challenge_insert(self, shard: StateShard, data: ChallengeData) -> None:
         old = shard._challenges.get(data.challenge_id)
         if old is None:
@@ -514,7 +512,6 @@ class ServerState:
             _challenge_wheel_key(data), set()
         ).add(data.challenge_id)
 
-    # cpzk-lint: disable=LOCK-001 -- mutation funnel: every caller holds the owning shard's lock (or runs single-threaded replay/restore)
     def _challenge_remove(
         self, shard: StateShard, challenge_id: bytes
     ) -> ChallengeData | None:

@@ -46,6 +46,58 @@ async def handler(self, request, context):
 """,
     b"f'{witness.secret().value}'\n",
     b"while witness.secret():\n    pass\n",
+    # threaded/process-spawning shapes: the execution-context inference
+    # (call graph, spawn-site seeding, propagation) and the context rules
+    # (THREAD-001/PROC-001) must hold their invariants over mutations of
+    # these too — self-referential spawns, nested defs, bound targets
+    b"""\
+import asyncio, threading
+class Lane:
+    def start(self):
+        threading.Thread(target=self._loop).start()
+    def _loop(self):
+        self._post()
+    def _post(self):
+        def _resolve():
+            self.fut.set_result(1)
+        self.loop.call_soon_threadsafe(_resolve)
+        self.fut.set_exception(ValueError())
+""",
+    b"""\
+import multiprocessing, threading
+def child(x):
+    return x
+class Sup:
+    def spawn(self):
+        lock = threading.Lock()
+        ctx = multiprocessing.get_context("spawn")
+        ctx.Process(target=self.spawn, args=(lock, self)).start()
+        ctx.Process(target=child, args=(1,)).start()
+""",
+    b"""\
+import asyncio, threading
+def a():
+    b()
+def b():
+    a()
+    asyncio.ensure_future(None)
+threading.Thread(target=a).start()
+""",
+    b"""\
+import struct, zlib
+_H = struct.Struct(">II")
+def frame(p):
+    crc = zlib.crc32(p) & 0xFFFFFFFF
+    return _H.pack(len(p), crc) + p
+""",
+    b"""\
+class ServerState:
+    async def bad(self, uid, data):
+        shard = self._shard_for_user(uid)
+        registry = shard._sessions if uid else shard._challenges
+        registry.pop(uid, None)
+""",
+    b"x = 1  # cpzk-lint: disable=THREAD-001,NO-SUCH-RULE -- stale on purpose\n",
 ]
 
 

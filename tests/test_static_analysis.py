@@ -9,12 +9,28 @@ Three layers:
   reads in ``state.restore`` / ``recovery.recover_state`` / the daemon's
   TLS load) or the PR-4 ``_abort_exhausted`` routing makes this test
   fail.
-- **Fixtures** — each of the 8 rules has at least one true-positive and
+- **Fixtures** — each of the 12 rules has at least one true-positive and
   one clean fixture, so a rule that silently stops firing (or starts
   over-firing) is caught here rather than by the empty self-host run.
-- **Contract** — waiver handling (a reason is mandatory), JSON schema
-  stability, the docs/rule-registry drift guard, and the secret-type
-  redaction guard.
+  The context rules (THREAD-001/PROC-001, plus ASYNC-001's nested-def
+  upgrade) additionally pin the execution-context inference itself
+  (spawn-site seeding, call-graph propagation, the sanctioned
+  call_soon_threadsafe bridge).
+- **Contract** — waiver handling (a reason is mandatory; a stale waiver
+  is a WAIVER-002 finding; ``--audit-waivers`` lists liveness), JSON
+  schema stability (v2: ``waivers`` audit list), the docs/rule-registry
+  drift guard, and the secret-type redaction guard.
+
+ISSUE 15's real-violation ledger (each reverts to a tier-1 failure):
+FRAME-001 — ``server/ingest.py`` hand-rolled the WAL frame header, now
+rides ``durability.wal.frame_payload`` (pinned below + self-host);
+WAIVER-002 — six stale LOCK-001 waivers on the ``state.py`` mutation
+funnels (they never suppressed anything: LOCK-001 treats
+parameter-rooted mutations as the caller's obligation), deleted.
+THREAD-001, FUNNEL-001, PROC-001: no live violations found — the
+dispatch lane already posts via call_soon_threadsafe, every registry
+mutation already routes through the funnels, and the ingest spawn
+already ships plain data (each pinned by a targeted self-host test).
 """
 
 from __future__ import annotations
@@ -35,6 +51,7 @@ PKG = os.path.join(REPO, "cpzk_tpu")
 CORE_RULES = [
     "CT-001", "CT-002", "LEAK-001", "LOCK-001",
     "ASYNC-001", "ASYNC-002", "GRPC-001", "JAX-001",
+    "THREAD-001", "FUNNEL-001", "PROC-001", "FRAME-001",
 ]
 
 
@@ -425,6 +442,35 @@ class TestASYNC001:
         report = analyze_source(src, path="cpzk_tpu/server/fx.py")
         assert "ASYNC-001" not in rules_of(report)
 
+    def test_nested_sync_def_called_inline_is_flagged(self):
+        """The context-inference upgrade (ISSUE 15): a nested helper the
+        async body calls inline runs ON the loop — the indirection no
+        longer hides the blocking call."""
+        src = (
+            "import time\n"
+            "async def handler():\n"
+            "    def helper():\n"
+            "        time.sleep(1)\n"
+            "    helper()\n"
+        )
+        report = analyze_source(src, path="cpzk_tpu/server/fx.py")
+        msgs = [f.message for f in report.findings if f.rule == "ASYNC-001"]
+        assert len(msgs) == 1
+        assert "helper" in msgs[0] and "handler" in msgs[0]
+
+    def test_nested_def_both_inline_and_to_thread_is_exempt(self):
+        """Shipped to a thread at least once -> the helper may block."""
+        src = (
+            "import asyncio, time\n"
+            "async def handler():\n"
+            "    def helper():\n"
+            "        time.sleep(1)\n"
+            "    helper()\n"
+            "    await asyncio.to_thread(helper)\n"
+        )
+        report = analyze_source(src, path="cpzk_tpu/server/fx.py")
+        assert "ASYNC-001" not in rules_of(report)
+
     def test_out_of_scope_plane_is_clean(self):
         src = "import time\nasync def f():\n    time.sleep(1)\n"
         report = analyze_source(src, path="cpzk_tpu/ops/fx.py")
@@ -570,6 +616,486 @@ class TestJAX001:
         assert "JAX-001" not in rules_of(report)
 
 
+# -- execution-context inference ----------------------------------------------
+
+
+class TestContextInference:
+    """The interprocedural layer the context rules read: spawn-site
+    seeding + caller->callee propagation (tentpole of ISSUE 15)."""
+
+    @staticmethod
+    def contexts_of(src: str) -> dict:
+        from cpzk_tpu.analysis.engine import parse_module
+
+        mod = parse_module(src, "cpzk_tpu/server/fx.py")
+        return {
+            info.qualname: set(info.contexts)
+            for info in mod.contexts.values()
+        }
+
+    def test_thread_target_and_propagation(self):
+        ctx = self.contexts_of(
+            "import threading\n"
+            "class Lane:\n"
+            "    def start(self):\n"
+            "        threading.Thread(target=self._loop).start()\n"
+            "    def _loop(self):\n"
+            "        self._post()\n"
+            "    def _post(self):\n"
+            "        pass\n"
+        )
+        assert "thread" in ctx["Lane._loop"]
+        assert "thread" in ctx["Lane._post"]  # propagated through the call
+        assert "thread" not in ctx["Lane.start"]
+
+    def test_to_thread_and_run_in_executor_targets(self):
+        ctx = self.contexts_of(
+            "import asyncio\n"
+            "async def handler(loop):\n"
+            "    def work():\n"
+            "        pass\n"
+            "    def work2():\n"
+            "        pass\n"
+            "    await asyncio.to_thread(work)\n"
+            "    await loop.run_in_executor(None, work2)\n"
+        )
+        assert "thread" in ctx["handler.work"]
+        assert "thread" in ctx["handler.work2"]
+
+    def test_spawn_target_is_process_context(self):
+        ctx = self.contexts_of(
+            "import multiprocessing\n"
+            "def child():\n"
+            "    helper()\n"
+            "def helper():\n"
+            "    pass\n"
+            "def spawn():\n"
+            "    ctx = multiprocessing.get_context('spawn')\n"
+            "    ctx.Process(target=child).start()\n"
+        )
+        assert "process" in ctx["child"]
+        assert "process" in ctx["helper"]  # propagated
+
+    def test_callback_runs_on_the_loop(self):
+        """A callable registered through call_soon_threadsafe is seeded
+        event-loop — the sanctioned bridge's callback is loop context."""
+        ctx = self.contexts_of(
+            "import threading\n"
+            "def worker(loop, fut):\n"
+            "    def deliver():\n"
+            "        fut.set_result(1)\n"
+            "    loop.call_soon_threadsafe(deliver)\n"
+            "threading.Thread(target=worker).start()\n"
+        )
+        assert "thread" in ctx["worker"]
+        assert ctx["worker.deliver"] == {"event-loop"}
+
+    def test_async_defs_absorb_no_thread_context(self):
+        """Calling an async def from a thread builds a coroutine; THREAD
+        must not flow into it."""
+        ctx = self.contexts_of(
+            "import threading\n"
+            "async def coro():\n"
+            "    pass\n"
+            "def worker():\n"
+            "    coro()\n"
+            "threading.Thread(target=worker).start()\n"
+        )
+        assert ctx["coro"] == {"event-loop"}
+
+    def test_nested_def_resolution_is_lexical(self):
+        ctx = self.contexts_of(
+            "import threading\n"
+            "def outer():\n"
+            "    def run():\n"
+            "        pass\n"
+            "    threading.Thread(target=run).start()\n"
+        )
+        assert "thread" in ctx["outer.run"]
+
+
+# -- THREAD-001 ---------------------------------------------------------------
+
+
+class TestTHREAD001:
+    def test_true_positive_thread_settles_future(self):
+        src = (
+            "import threading\n"
+            "class Lane:\n"
+            "    def start(self):\n"
+            "        threading.Thread(target=self._loop).start()\n"
+            "    def _loop(self):\n"
+            "        self._post()\n"
+            "    def _post(self):\n"
+            "        self.fut.set_result(1)\n"
+        )
+        report = analyze_source(src, path="cpzk_tpu/server/fx.py")
+        msgs = [f.message for f in report.findings if f.rule == "THREAD-001"]
+        assert len(msgs) == 1
+        assert "set_result" in msgs[0] and "call_soon_threadsafe" in msgs[0]
+
+    def test_true_positive_to_thread_schedules_task(self):
+        src = (
+            "import asyncio\n"
+            "async def handler(self):\n"
+            "    def work():\n"
+            "        asyncio.ensure_future(self.job())\n"
+            "    await asyncio.to_thread(work)\n"
+        )
+        report = analyze_source(src, path="cpzk_tpu/server/fx.py")
+        assert "THREAD-001" in rules_of(report)
+
+    def test_clean_call_soon_threadsafe_bridge(self):
+        """The dispatch lane's exact posting pattern: the bridge call is
+        sanctioned and the callback is event-loop context."""
+        src = (
+            "import threading\n"
+            "class Lane:\n"
+            "    def start(self):\n"
+            "        threading.Thread(target=self._post).start()\n"
+            "    def _post(self):\n"
+            "        def _resolve():\n"
+            "            self.fut.set_result(1)\n"
+            "        self.loop.call_soon_threadsafe(_resolve)\n"
+        )
+        report = analyze_source(src, path="cpzk_tpu/server/fx.py")
+        assert "THREAD-001" not in rules_of(report)
+
+    def test_clean_thread_owned_loop(self):
+        """The start_in_thread bootstrap: a loop the thread itself
+        created is driven with call_soon legitimately."""
+        src = (
+            "import asyncio, threading\n"
+            "def start_in_thread(self):\n"
+            "    def run():\n"
+            "        loop = asyncio.new_event_loop()\n"
+            "        loop.call_soon(self.start)\n"
+            "        loop.run_forever()\n"
+            "    threading.Thread(target=run).start()\n"
+        )
+        report = analyze_source(src, path="cpzk_tpu/server/fx.py")
+        assert "THREAD-001" not in rules_of(report)
+
+    def test_clean_event_loop_context_untouched(self):
+        src = (
+            "async def handler(self):\n"
+            "    self.fut.set_result(1)\n"
+            "def plain(self):\n"
+            "    self.fut.set_result(1)\n"
+        )
+        report = analyze_source(src, path="cpzk_tpu/server/fx.py")
+        assert "THREAD-001" not in rules_of(report)
+
+
+# -- FUNNEL-001 ---------------------------------------------------------------
+
+
+class TestFUNNEL001:
+    def test_true_positive_direct_shard_write(self):
+        src = (
+            "class ServerState:\n"
+            "    async def bad(self, uid, data):\n"
+            "        shard = self._shard_for_user(uid)\n"
+            "        async with shard.lock:\n"
+            "            shard._sessions[uid] = data\n"
+        )
+        report = analyze_source(src, path="cpzk_tpu/server/state.py")
+        msgs = [f.message for f in report.findings if f.rule == "FUNNEL-001"]
+        assert len(msgs) == 1
+        assert "_session_insert" in msgs[0]
+
+    def test_true_positive_registry_alias_pop(self):
+        """The sweep's ternary alias shape must not hide a mutation."""
+        src = (
+            "class ServerState:\n"
+            "    async def bad(self, key, is_sessions):\n"
+            "        for shard in self._shards:\n"
+            "            registry = (\n"
+            "                shard._sessions if is_sessions\n"
+            "                else shard._challenges\n"
+            "            )\n"
+            "            registry.pop(key, None)\n"
+        )
+        report = analyze_source(src, path="cpzk_tpu/server/state.py")
+        assert "FUNNEL-001" in rules_of(report)
+
+    def test_true_positive_del_through_self(self):
+        src = (
+            "class ServerState:\n"
+            "    async def bad(self, uid):\n"
+            "        del self._users[uid]\n"
+        )
+        report = analyze_source(src, path="cpzk_tpu/server/state.py")
+        assert "FUNNEL-001" in rules_of(report)
+
+    def test_clean_funnels_and_reads(self):
+        src = (
+            "class ServerState:\n"
+            "    def __init__(self):\n"
+            "        self._users = {}\n"
+            "    def _session_insert(self, shard, data):\n"
+            "        shard._sessions[data.token] = data\n"
+            "    def _session_remove(self, shard, token):\n"
+            "        return shard._sessions.pop(token, None)\n"
+            "    async def good(self, uid, data):\n"
+            "        shard = self._shard_for_user(uid)\n"
+            "        async with shard.lock:\n"
+            "            self._session_insert(shard, data)\n"
+            "            return shard._sessions.get(uid)\n"
+        )
+        report = analyze_source(src, path="cpzk_tpu/server/state.py")
+        assert "FUNNEL-001" not in rules_of(report)
+
+    def test_other_classes_out_of_scope(self):
+        src = (
+            "class Cache:\n"
+            "    def put(self, k, v):\n"
+            "        self._sessions[k] = v\n"
+        )
+        report = analyze_source(src, path="cpzk_tpu/server/batching.py")
+        assert "FUNNEL-001" not in rules_of(report)
+
+    def test_real_state_self_hosts(self):
+        """The live ServerState routes every registry mutation through
+        the six funnels — zero FUNNEL-001 findings, no waivers needed."""
+        report = analyze_paths(
+            [os.path.join(PKG, "server", "state.py")], rules=["FUNNEL-001"]
+        )
+        assert [f.render() for f in report.findings] == []
+
+
+# -- PROC-001 -----------------------------------------------------------------
+
+
+class TestPROC001:
+    def test_true_positive_bound_method_target(self):
+        src = (
+            "import multiprocessing\n"
+            "class Sup:\n"
+            "    def spawn(self):\n"
+            "        ctx = multiprocessing.get_context('spawn')\n"
+            "        ctx.Process(target=self._run).start()\n"
+        )
+        report = analyze_source(src, path="cpzk_tpu/server/fx.py")
+        msgs = [f.message for f in report.findings if f.rule == "PROC-001"]
+        assert len(msgs) == 1 and "bound" in msgs[0]
+
+    def test_true_positive_nested_def_target(self):
+        src = (
+            "import multiprocessing\n"
+            "def spawn():\n"
+            "    def child():\n"
+            "        pass\n"
+            "    multiprocessing.Process(target=child).start()\n"
+        )
+        report = analyze_source(src, path="cpzk_tpu/server/fx.py")
+        assert "PROC-001" in rules_of(report)
+
+    def test_true_positive_lambda_target(self):
+        src = (
+            "import multiprocessing\n"
+            "multiprocessing.Process(target=lambda: None).start()\n"
+        )
+        report = analyze_source(src, path="cpzk_tpu/server/fx.py")
+        assert "PROC-001" in rules_of(report)
+
+    def test_true_positive_unsafe_args(self):
+        src = (
+            "import multiprocessing, threading\n"
+            "def child(x, y):\n"
+            "    pass\n"
+            "class Sup:\n"
+            "    def spawn(self):\n"
+            "        lock = threading.Lock()\n"
+            "        ctx = multiprocessing.get_context('spawn')\n"
+            "        ctx.Process(target=child, args=(lock, self)).start()\n"
+        )
+        report = analyze_source(src, path="cpzk_tpu/server/fx.py")
+        msgs = [f.message for f in report.findings if f.rule == "PROC-001"]
+        assert len(msgs) == 2
+        assert any("lock" in m for m in msgs)
+        assert any("`self`" in m for m in msgs)
+
+    def test_clean_module_level_target_plain_args(self):
+        """The real ingest spawn shape: module-level target, primitives,
+        attribute reads (self.host is a value, not the instance)."""
+        src = (
+            "import multiprocessing\n"
+            "def run_shard(i, path, opts):\n"
+            "    pass\n"
+            "class Sup:\n"
+            "    def spawn(self, index):\n"
+            "        ctx = multiprocessing.get_context('spawn')\n"
+            "        ctx.Process(\n"
+            "            target=run_shard,\n"
+            "            args=(index, self.uds_path, {'host': self.host}),\n"
+            "        ).start()\n"
+        )
+        report = analyze_source(src, path="cpzk_tpu/server/fx.py")
+        assert "PROC-001" not in rules_of(report)
+
+    def test_real_ingest_self_hosts(self):
+        report = analyze_paths(
+            [os.path.join(PKG, "server", "ingest.py")], rules=["PROC-001"]
+        )
+        assert [f.render() for f in report.findings] == []
+
+
+# -- FRAME-001 ----------------------------------------------------------------
+
+
+class TestFRAME001:
+    TP = (
+        "import struct, zlib\n"
+        "_H = struct.Struct('>II')\n"
+        "def frame(p: bytes) -> bytes:\n"
+        "    crc = zlib.crc32(p) & 0xFFFFFFFF\n"
+        "    return _H.pack(len(p), crc) + p\n"
+    )
+
+    def test_true_positive_hand_rolled_frame(self):
+        report = analyze_source(self.TP, path="cpzk_tpu/server/fx.py")
+        msgs = [f.message for f in report.findings if f.rule == "FRAME-001"]
+        # the private header declaration AND the pack-with-crc are each
+        # findings (reverting the ingest refactor re-fails on both)
+        assert len(msgs) == 2
+        assert any("frame_payload" in m for m in msgs)
+        assert any("'>II'" in m for m in msgs)
+
+    def test_wal_itself_is_the_canonical_home(self):
+        report = analyze_source(self.TP, path="cpzk_tpu/durability/wal.py")
+        assert "FRAME-001" not in rules_of(report)
+
+    def test_clean_non_framing_crc(self):
+        """Whole-object CRCs (segment checksums, shard hashes) that never
+        enter a packed header are out of scope."""
+        src = (
+            "import zlib\n"
+            "def shard_index(uid: str, n: int) -> int:\n"
+            "    return zlib.crc32(uid.encode()) % n\n"
+            "def seg_crc(frames: bytes) -> int:\n"
+            "    return zlib.crc32(frames) & 0xFFFFFFFF\n"
+        )
+        report = analyze_source(src, path="cpzk_tpu/replication/fx.py")
+        assert "FRAME-001" not in rules_of(report)
+
+    def test_real_ingest_uses_shared_helpers(self):
+        """The FRAME-001 fix of this PR: reverting server/ingest.py to
+        its hand-rolled _HEADER re-fails here (and in the self-host)."""
+        report = analyze_paths(
+            [os.path.join(PKG, "server", "ingest.py")], rules=["FRAME-001"]
+        )
+        assert [f.render() for f in report.findings] == []
+
+    def test_shared_helpers_are_byte_identical(self):
+        """pack_frame rides wal.frame_payload — one framing contract."""
+        from cpzk_tpu.durability.wal import frame_payload, iter_frames
+        from cpzk_tpu.server.ingest import pack_frame
+
+        payload = b'{"seq":1,"type":"x"}'
+        assert pack_frame(payload) == frame_payload(payload)
+        rec, valid = iter_frames(frame_payload(payload))
+        assert rec == [{"seq": 1, "type": "x"}]
+        assert valid == len(frame_payload(payload))
+
+
+# -- WAIVER-002 ---------------------------------------------------------------
+
+
+class TestWAIVER002:
+    def test_stale_waiver_is_a_finding(self):
+        src = "x = 1  # cpzk-lint: disable=CT-001 -- nothing fires here\n"
+        report = analyze_source(src, path="cpzk_tpu/server/fx.py")
+        assert [f.rule for f in report.findings] == ["WAIVER-002"]
+        assert "stale" in report.findings[0].message
+
+    def test_live_waiver_is_not_stale(self):
+        src = (
+            "import asyncio\n"
+            "asyncio.create_task(f())  "
+            "# cpzk-lint: disable=ASYNC-002 -- fixture: live\n"
+        )
+        report = analyze_source(src, path="cpzk_tpu/server/fx.py")
+        assert report.findings == []
+
+    def test_unknown_rule_id_is_stale(self):
+        src = "x = 1  # cpzk-lint: disable=NO-SUCH-RULE -- typo'd id\n"
+        report = analyze_source(src, path="cpzk_tpu/server/fx.py")
+        assert [f.rule for f in report.findings] == ["WAIVER-002"]
+
+    def test_mixed_waiver_reports_only_the_stale_id(self):
+        src = (
+            "import asyncio\n"
+            "asyncio.create_task(f())  "
+            "# cpzk-lint: disable=ASYNC-002,CT-001 -- one live, one stale\n"
+        )
+        report = analyze_source(src, path="cpzk_tpu/server/fx.py")
+        assert [f.rule for f in report.findings] == ["WAIVER-002"]
+        assert "CT-001" in report.findings[0].message
+        assert "ASYNC-002" not in report.findings[0].message
+
+    def test_rules_filter_cannot_judge_staleness(self):
+        """A --rules run that skipped the waived rule must not call its
+        waiver stale (the rule never got a chance to fire)."""
+        from cpzk_tpu.analysis.engine import _analyze
+
+        src = "x = 1  # cpzk-lint: disable=CT-001 -- fixture\n"
+        report = _analyze(
+            [(src, "cpzk_tpu/server/fx.py")], ["ASYNC-002", "WAIVER-002"]
+        )
+        assert report.findings == []
+
+    def test_waiver_002_cannot_be_waived(self):
+        """Emitted by the engine after waiver matching — a disable
+        comment cannot suppress its own staleness."""
+        src = (
+            "x = 1  "
+            "# cpzk-lint: disable=CT-001,WAIVER-002 -- try to self-excuse\n"
+        )
+        report = analyze_source(src, path="cpzk_tpu/server/fx.py")
+        assert "WAIVER-002" in [f.rule for f in report.findings]
+
+    def test_docstring_mention_is_not_a_waiver(self):
+        """The tokenize-based comment scan: waiver syntax quoted inside a
+        string/docstring (the docs do) must not register at all."""
+        src = (
+            '"""Write `# cpzk-lint: disable=CT-001 -- why` inline."""\n'
+            "MSG = 'use # cpzk-lint: disable=LOCK-001 -- reason'\n"
+        )
+        report = analyze_source(src, path="cpzk_tpu/server/fx.py")
+        assert report.findings == []
+        assert report.waivers == []
+
+    def test_real_tree_has_no_stale_waivers(self):
+        report = analyze_paths([PKG])
+        stale = [w.render() for w in report.waivers if w.stale]
+        assert stale == []
+        assert all(w.reason for w in report.waivers)
+
+    def test_audit_waivers_cli(self):
+        proc = subprocess.run(
+            [sys.executable, "-m", "cpzk_tpu.analysis", PKG,
+             "--audit-waivers"],
+            capture_output=True, text=True, cwd=REPO,
+        )
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "waivers (0 stale)" in proc.stdout
+        assert "state.py" in proc.stdout  # the documented LOCK-001 trio
+        assert "active (" in proc.stdout
+
+    def test_audit_waivers_cli_exits_one_on_stale(self, tmp_path):
+        bad = tmp_path / "cpzk_tpu" / "server" / "fx.py"
+        bad.parent.mkdir(parents=True)
+        bad.write_text("x = 1  # cpzk-lint: disable=CT-001 -- stale\n")
+        proc = subprocess.run(
+            [sys.executable, "-m", "cpzk_tpu.analysis", str(bad),
+             "--audit-waivers"],
+            capture_output=True, text=True, cwd=REPO,
+        )
+        assert proc.returncode == 1
+        assert "STALE" in proc.stdout
+
+
 # -- waivers ------------------------------------------------------------------
 
 
@@ -633,15 +1159,25 @@ class TestWaivers:
 
 class TestReportContract:
     def test_json_schema_stable(self):
-        """Drift guard: the CI artifact's consumers pin these keys."""
+        """Drift guard: the CI artifact's consumers pin these keys.
+        Version 2 added the ``waivers`` audit list (WAIVER-002)."""
         doc = analyze_source("x = 1\n").to_dict()
         assert sorted(doc) == [
             "files", "findings", "rule_ids", "schema_version", "summary",
-            "tool", "waived",
+            "tool", "waived", "waivers",
         ]
-        assert doc["schema_version"] == 1
+        assert doc["schema_version"] == 2
         assert doc["tool"] == "cpzk-lint"
         assert sorted(doc["summary"]) == ["findings", "waived"]
+        waivers = analyze_source(
+            "import asyncio\n"
+            "asyncio.create_task(f())  "
+            "# cpzk-lint: disable=ASYNC-002 -- fixture: schema pin\n",
+            path="cpzk_tpu/server/fx.py",
+        ).to_dict()["waivers"]
+        assert sorted(waivers[0]) == [
+            "line", "path", "reason", "rules", "stale", "waived",
+        ]
         bad = analyze_source(
             "import asyncio\nasyncio.create_task(f())\n",
             path="cpzk_tpu/server/fx.py",
@@ -651,7 +1187,7 @@ class TestReportContract:
         ]
 
     def test_registry_has_the_promised_rule_pack(self):
-        for rule_id in CORE_RULES + ["WAIVER-001", "PARSE-001"]:
+        for rule_id in CORE_RULES + ["WAIVER-001", "WAIVER-002", "PARSE-001"]:
             assert rule_id in REGISTRY, rule_id
         assert all_rule_ids() == sorted(REGISTRY)
 
