@@ -28,10 +28,18 @@ in about a minute on one core and is what CI's ``soak-smoke`` job gates
 against the committed ``BENCH_SOAK_BASELINE.json``; the committed
 ``BENCH_SOAK.json`` is a full 1M-user CPU run.
 
+``--storm {herd,brownout,split,crashloop,all}`` (ISSUE 16) switches the
+driver into the failure-storm scenario suite: thundering-herd reconnect
+after a primary SIGKILL, slow-chip lane brownout under the live fleet
+controller, a controller-triggered partition split at full write load,
+and an ingest-shard crash-loop — each asserting zero acked-write loss
+and bounded login burn, with no human action anywhere.
+
 Usage::
 
     python benches/bench_soak.py --users 1000000 --qps 1000 \
         --duration 60 --snapshot BENCH_SOAK.json
+    python benches/bench_soak.py --storm all --storm-users 2000
 """
 
 from __future__ import annotations
@@ -368,6 +376,696 @@ async def measure_failover(
     raise RuntimeError("standby never served a login after primary SIGKILL")
 
 
+# -- failure-storm scenario suite (ISSUE 16) ----------------------------------
+#
+# ``--storm {herd,brownout,split,crashloop,all}`` runs self-driving-fleet
+# storms instead of the throughput soak.  Every leg asserts the same two
+# robustness invariants end to end, with NO human action anywhere:
+#
+# - ZERO acked-write loss: anything acknowledged to a client exists
+#   afterwards, on exactly one partition;
+# - BOUNDED login burn: the outage window and the post-recovery error
+#   ratio stay under explicit ceilings.
+#
+#   herd       thundering-herd reconnect: a replicated pair's primary is
+#              SIGKILLed under a damped client herd; the auto-promoted
+#              standby must absorb the synchronized reconnect wave
+#              (single-flight map refresh, jittered re-dials) and serve
+#              every previously registered user.
+#   brownout   slow-chip brownout: FaultPlan latency + failures into one
+#              router lane; the live controller drains the lane, every
+#              batch still verifies via the healthy lane, and the lane is
+#              re-admitted once its breaker re-closes.
+#   split      controller-triggered live partition split under full write
+#              load; every acknowledged registration lands on exactly one
+#              side of the v2 map.
+#   crashloop  ingest-shard crash-loop: one shard SIGKILLed through its
+#              backoff schedule until the supervisor gives up (crashloop
+#              marker), while the surviving shard keeps serving logins.
+#
+# Violations are collected per leg and make the exit code nonzero; each
+# leg also prints a JSON report for eyeballing/trending.
+
+HERD_WORKERS_PER_CLIENT = 8   # concurrent login loops sharing one client
+RECOVERY_CEILING_S = 30.0     # herd: kill -> first served login
+POST_BURN_CEILING = 0.02      # herd: error ratio after recovery + grace
+
+
+def ops_json(ops_port: int, path: str) -> dict:
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{ops_port}{path}", timeout=5
+    ) as r:
+        return json.loads(r.read())
+
+
+async def _full_login(client, uid: str, prover, rng,
+                      timeout: float = 2.0) -> bool:
+    from cpzk_tpu import Transcript
+
+    ch = await client.create_challenge(uid, timeout=timeout)
+    cid = bytes(ch.challenge_id)
+    t = Transcript()
+    t.append_context(cid)
+    proof = prover.prove_with_transcript(rng, t)
+    resp = await client.verify_proof(uid, cid, proof.to_bytes(),
+                                     timeout=timeout)
+    return bool(resp.success)
+
+
+async def storm_herd(args) -> dict:
+    """Thundering-herd reconnect after a primary SIGKILL."""
+    from cpzk_tpu.client import AuthClient
+    from cpzk_tpu.fleet import PartitionMap
+
+    # every successful login mints a session and sessions are capped at
+    # MAX_SESSIONS_PER_USER=5 (reference parity): keep the corpus large
+    # relative to the paced herd's login volume so no user's quota runs
+    # out mid-storm
+    users = max(args.storm_users, 5000)
+    state_dir = tempfile.mkdtemp(prefix="cpzk-storm-herd-")
+    port, ops = args.port, args.ops_port
+    sb_port, sb_ops = port + 1, ops + 1
+    primary_addr = f"127.0.0.1:{port}"
+    standby_addr = f"127.0.0.1:{sb_port}"
+    procs: list[subprocess.Popen] = []
+    violations: list[str] = []
+    herd: list = []
+    try:
+        for name in ("primary", "standby"):
+            os.makedirs(os.path.join(state_dir, name), exist_ok=True)
+        standby = spawn_daemon(
+            sb_port,
+            daemon_env(os.path.join(state_dir, "standby"), users, sb_ops,
+                       role="standby"),
+            os.path.join(state_dir, "standby.log"),
+        )
+        procs.append(standby)
+        wait_healthy(sb_ops)
+        primary = spawn_daemon(
+            port,
+            daemon_env(os.path.join(state_dir, "primary"), users, ops,
+                       role="primary", peer=standby_addr),
+            os.path.join(state_dir, "primary.log"),
+        )
+        procs.append(primary)
+        wait_healthy(ops)
+
+        rng, provers, y1s, y2s = build_corpus()
+        await register_users(primary_addr, users, y1s, y2s)
+        # async replication: give the shipper a beat so everything acked
+        # above is on the standby before the kill (the leg measures herd
+        # behavior, not the async-mode replication-lag contract)
+        await asyncio.sleep(2.0)
+
+        # the herd: N clients x M login workers, all damped.  Clients
+        # start routed at the primary; on failure a worker asks for a map
+        # refresh (single-flight per client) whose fetch returns the
+        # standby map — exactly the /partitionmap re-point a real control
+        # plane would serve after promotion.
+        def fresh_map():
+            return PartitionMap.uniform([standby_addr], version=2)
+
+        for _ in range(args.storm_clients):
+            herd.append(AuthClient(
+                primary_addr,
+                partition_map=PartitionMap.uniform([primary_addr]),
+                map_refresh=fresh_map,
+                refresh_jitter_s=0.2,
+                reconnect_damp_s=0.3,
+            ))
+        stop = asyncio.Event()
+        ok_t: list[float] = []
+        ok_standby_t: list[float] = []  # successes served under the v2 map
+        err_t: list[float] = []
+
+        async def worker(client, k0: int):
+            k = k0
+            while not stop.is_set():
+                uid_n = k % users
+                try:
+                    good = await _full_login(
+                        client, f"su{uid_n}", provers[uid_n % POOL], rng,
+                    )
+                    now = time.monotonic()
+                    (ok_t if good else err_t).append(now)
+                    if good and client.partition_map.version >= 2:
+                        ok_standby_t.append(now)
+                except asyncio.CancelledError:
+                    raise
+                except Exception:  # noqa: BLE001 - the storm IS the errors
+                    err_t.append(time.monotonic())
+                    try:
+                        await client._refresh_map()  # damped + coalesced
+                    except Exception:  # noqa: BLE001
+                        pass
+                k += 7
+                await asyncio.sleep(0.08)
+
+        workers = [
+            asyncio.ensure_future(worker(c, i * 1013 + j * 131))
+            for i, c in enumerate(herd)
+            for j in range(HERD_WORKERS_PER_CLIENT)
+        ]
+        await asyncio.sleep(2.0)            # warm the herd on the primary
+        pre_ok = len(ok_t)
+        primary.send_signal(signal.SIGKILL)
+        primary.wait(timeout=30)
+        t_kill = time.monotonic()
+        print("# herd: primary SIGKILLed under "
+              f"{len(workers)} login workers", file=sys.stderr, flush=True)
+
+        # recovery = the first login served under the standby's (v2) map:
+        # a primary ack racing the SIGKILL must not count as "recovered"
+        recovery_s = None
+        deadline = t_kill + 60.0
+        while time.monotonic() < deadline:
+            post = [t for t in ok_standby_t if t > t_kill]
+            if post:
+                recovery_s = post[0] - t_kill
+                break
+            await asyncio.sleep(0.05)
+        await asyncio.sleep(args.storm_duration)
+        stop.set()
+        await asyncio.gather(*workers, return_exceptions=True)
+
+        grace = t_kill + (recovery_s if recovery_s is not None else 60.0) + 1.0
+        post_ok = len([t for t in ok_t if t > grace])
+        post_err = len([t for t in err_t if t > grace])
+        burn = post_err / max(1, post_ok + post_err)
+        coalesced = sum(c.refresh_coalesced for c in herd)
+        damped = sum(c.reconnects_damped for c in herd)
+        fetches = sum(c.refresh_fetches for c in herd)
+
+        if recovery_s is None:
+            violations.append("standby never served a herd login within 60s")
+        elif recovery_s > RECOVERY_CEILING_S:
+            violations.append(
+                f"recovery {recovery_s:.1f}s > {RECOVERY_CEILING_S}s ceiling"
+            )
+        if burn > POST_BURN_CEILING:
+            violations.append(
+                f"post-recovery burn {burn:.4f} > {POST_BURN_CEILING} "
+                f"({post_err} errors / {post_ok + post_err} attempts)"
+            )
+        if coalesced == 0:
+            violations.append("herd damping never engaged: no coalesced "
+                              "map refreshes under a synchronized wave")
+
+        # ZERO acked-write loss: every registration acked by the dead
+        # primary must be servable on the promoted standby
+        sample_n = min(200, users)
+        stride = max(1, users // sample_n)
+        lost = 0
+        async with AuthClient(standby_addr) as checker:
+            for j in range(sample_n):
+                k = (j * stride) % users
+                try:
+                    if not await _full_login(
+                        checker, f"su{k}", provers[k % POOL], rng,
+                        timeout=5.0,
+                    ):
+                        lost += 1
+                except Exception:  # noqa: BLE001
+                    lost += 1
+        if lost:
+            violations.append(
+                f"acked-write loss: {lost}/{sample_n} sampled registrations "
+                "not servable on the promoted standby"
+            )
+
+        try:
+            pages = ops_json(sb_ops, "/slo").get("pages_fired")
+        except Exception:  # noqa: BLE001
+            pages = None
+        return {
+            "leg": "herd",
+            "users": users,
+            "clients": len(herd),
+            "workers": len(workers),
+            "pre_kill_logins": pre_ok,
+            "recovery_ms": (round(recovery_s * 1000.0, 1)
+                            if recovery_s is not None else None),
+            "post_recovery_ok": post_ok,
+            "post_recovery_errors": post_err,
+            "post_recovery_burn": round(burn, 5),
+            "refresh_fetches": fetches,
+            "refresh_coalesced": coalesced,
+            "reconnects_damped": damped,
+            "sampled_users_checked": sample_n,
+            "sampled_users_lost": lost,
+            "standby_pages_fired": pages,
+            "violations": violations,
+        }
+    finally:
+        for c in herd:
+            try:
+                await c.close()
+            except Exception:  # noqa: BLE001
+                pass
+        for proc in procs:
+            if proc.poll() is None:
+                proc.send_signal(signal.SIGTERM)
+        for proc in procs:
+            try:
+                proc.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+        if not args.keep_state:
+            shutil.rmtree(state_dir, ignore_errors=True)
+
+
+async def storm_brownout(args) -> dict:
+    """Slow-chip brownout: the controller drains the faulted lane and
+    re-admits it after the breaker re-closes; no batch is ever lost."""
+    from cpzk_tpu import Parameters, Prover, SecureRng, Transcript, Witness
+    from cpzk_tpu.core.ristretto import Ristretto255
+    from cpzk_tpu.fleet.controller import (
+        ACTION_LANE_DRAIN, ACTION_LANE_READMIT, FleetController,
+    )
+    from cpzk_tpu.protocol.batch import BatchEntry, CpuBackend
+    from cpzk_tpu.resilience.faults import FaultInjectionBackend, FaultPlan
+    from cpzk_tpu.server.config import ControllerSettings
+    from cpzk_tpu.server.router import LaneRouter
+
+    rng = SecureRng()
+    params = Parameters.new()
+    provers = [
+        Prover(params, Witness(Ristretto255.random_scalar(rng)))
+        for _ in range(8)
+    ]
+
+    def make_batch(tag: int, n: int = 8) -> list:
+        out = []
+        for i in range(n):
+            p = provers[(tag + i) % len(provers)]
+            ctx = b"storm-brownout-%06d" % (tag * n + i)
+            t = Transcript()
+            t.append_context(ctx)
+            out.append(BatchEntry(
+                params, p.statement, p.prove_with_transcript(rng, t), ctx,
+            ))
+        return out
+
+    violations: list[str] = []
+
+    # dry-run preflight: a controller in dry_run watches a lane whose
+    # breaker is forced open (every call on the faulted backend raises)
+    # — it must emit the LANE_DRAIN decision WITHOUT actuating: same
+    # decision stream, lane stays placed.  Proves the preview contract
+    # at storm scale before the live phase below.
+    dry_plan = FaultPlan(seed=17).fail_range(0, 256)
+    dry_router = LaneRouter(
+        [CpuBackend(), FaultInjectionBackend(CpuBackend(), dry_plan)],
+        recovery_after_s=30.0,
+    )
+    dry_router.start()
+    dry_controller = FleetController(
+        ControllerSettings(
+            enabled=True, dry_run=True, act_ticks=2, clear_ticks=2,
+            lane_open_after_s=0.05, lane_cooldown_s=0.5,
+        ),
+        router=dry_router,
+    )
+    dry_decisions = []
+    try:
+        dry_deadline = time.monotonic() + 20.0
+        while time.monotonic() < dry_deadline:
+            try:
+                await dry_router.submit(make_batch(0, 2), None)
+            except Exception:  # noqa: BLE001 - the injected fault
+                pass
+            await asyncio.sleep(0.05)
+            dry_decisions.extend(await dry_controller.tick())
+            if any(d.action == ACTION_LANE_DRAIN for d in dry_decisions):
+                break
+    finally:
+        dry_lanes = dry_router.lane_states()
+        await dry_router.stop()
+    if not any(d.action == ACTION_LANE_DRAIN for d in dry_decisions):
+        violations.append(
+            "dry-run controller never proposed LANE_DRAIN under a "
+            "forced-open breaker")
+    if any(d.fired for d in dry_decisions):
+        violations.append("dry-run controller actuated a decision")
+    if any(lane["drained"] for lane in dry_lanes):
+        violations.append("dry-run phase left a lane drained")
+
+    # lane 1 browns out: every batch +20ms, and calls 1..11 raise — the
+    # breaker opens on the first failure, probe traffic keeps advancing
+    # the plan, and the lane heals once the window passes
+    plan = (FaultPlan(seed=16)
+            .latency(0.02, every=2)
+            .fail_range(1, 12))
+    router = LaneRouter(
+        [CpuBackend(), FaultInjectionBackend(CpuBackend(), plan)],
+        recovery_after_s=0.5,
+    )
+    router.start()
+    controller = FleetController(
+        ControllerSettings(
+            enabled=True, dry_run=False, act_ticks=2, clear_ticks=2,
+            lane_open_after_s=0.3, lane_cooldown_s=0.5,
+        ),
+        router=router,
+    )
+    fired: list[str] = []
+    submitted = retried = rejected = lost = 0
+    batches = [make_batch(tag) for tag in range(6)]
+    deadline = time.monotonic() + 60.0
+
+    async def tick() -> None:
+        for d in await controller.tick():
+            if d.fired:
+                fired.append(d.action)
+
+    try:
+        i = 0
+        while time.monotonic() < deadline:
+            entries = batches[i % len(batches)]
+            i += 1
+            ok = False
+            while not ok and time.monotonic() < deadline:
+                try:
+                    results = await router.submit(entries, None)
+                    # lane contract: per-entry result is None on accept,
+                    # an error object on reject
+                    if any(r is not None for r in results):
+                        rejected += 1
+                        break
+                    ok = True
+                except Exception:  # noqa: BLE001 - the injected fault
+                    retried += 1
+                    await asyncio.sleep(0.02)
+                await tick()
+            submitted += 1
+            if not ok:
+                lost += 1
+            await tick()
+            if (ACTION_LANE_DRAIN in fired
+                    and ACTION_LANE_READMIT in fired):
+                break
+            await asyncio.sleep(0.01)
+    finally:
+        lanes = router.lane_states()
+        decisions = controller.status()["decisions"][-8:]
+        await router.stop()
+
+    if ACTION_LANE_DRAIN not in fired:
+        violations.append("controller never drained the browned-out lane")
+    if ACTION_LANE_READMIT not in fired:
+        violations.append("drained lane was never re-admitted after healing")
+    if rejected:
+        violations.append(f"{rejected} valid batches rejected")
+    if lost:
+        violations.append(f"{lost} batches never verified (work lost)")
+    return {
+        "leg": "brownout",
+        "dry_run_decisions": len(dry_decisions),
+        "dry_run_drain_proposed": any(
+            d.action == ACTION_LANE_DRAIN for d in dry_decisions),
+        "batches_verified": submitted - lost,
+        "resubmissions": retried,
+        "actions_fired": fired,
+        "final_lanes": lanes,
+        "last_decisions": decisions,
+        "violations": violations,
+    }
+
+
+async def storm_split(args) -> dict:
+    """Controller-triggered live split under full write load: every
+    acknowledged registration exists on exactly one partition after."""
+    from cpzk_tpu import Parameters, Prover, SecureRng, Witness
+    from cpzk_tpu.core.ristretto import Ristretto255
+    from cpzk_tpu.durability.recovery import recover_state
+    from cpzk_tpu.fleet import FleetRouter, PartitionMap
+    from cpzk_tpu.fleet.controller import ACTION_SPLIT, FleetController
+    from cpzk_tpu.server.config import ControllerSettings
+    from cpzk_tpu.server.state import ServerState, UserData
+
+    rng = SecureRng()
+    params = Parameters.new()
+    stmt = Prover(params, Witness(Ristretto255.random_scalar(rng))).statement
+    users = args.storm_users
+    state_dir = tempfile.mkdtemp(prefix="cpzk-storm-split-")
+    map_path = os.path.join(state_dir, "map.json")
+    violations: list[str] = []
+    try:
+        PartitionMap.uniform(["127.0.0.1:1"]).store(map_path)
+        state = ServerState(max_users=max(users * 100, 1_000_000))
+        seeded = [f"storm-{i:06d}" for i in range(users)]
+        for uid in seeded:
+            await state.register_user(UserData(uid, stmt, 1))
+        fleet = FleetRouter(PartitionMap.load(map_path), 0,
+                            map_path=map_path)
+        controller = FleetController(
+            ControllerSettings(
+                enabled=True, dry_run=False, act_ticks=2,
+                split_user_threshold=max(1, users // 2),
+                split_target_address="127.0.0.1:2",
+            ),
+            state=state, fleet=fleet, segment_bytes=64 * 1024,
+        )
+        acked: list[str] = []
+        redirected = 0
+        stop = asyncio.Event()
+
+        async def writer(wid: int):
+            # the daemon's service layer checks ownership against the
+            # live map BEFORE touching state; emulate that gate so "ack"
+            # means what the daemon's ack means
+            nonlocal redirected
+            i = 0
+            while not stop.is_set():
+                uid = f"storm-w{wid}-{i:06d}"
+                if fleet.map.partition_for(uid).index == fleet.self_index:
+                    await state.register_user(UserData(uid, stmt, 1))
+                    acked.append(uid)
+                else:
+                    redirected += 1
+                i += 1
+                await asyncio.sleep(0)
+
+        writers = [asyncio.ensure_future(writer(w)) for w in range(4)]
+        split_report = None
+        deadline = time.monotonic() + 60.0
+        while time.monotonic() < deadline:
+            out = await controller.tick()
+            hits = [d for d in out if d.fired and d.action == ACTION_SPLIT]
+            if hits:
+                split_report = hits[0].detail["report"]
+                break
+            await asyncio.sleep(0.02)
+        await asyncio.sleep(0.1)        # post-flip traffic hits the gate
+        stop.set()
+        await asyncio.gather(*writers)
+
+        if split_report is None:
+            violations.append("controller never fired the live split")
+            return {"leg": "split", "violations": violations}
+
+        target = ServerState()
+        await recover_state(
+            target, split_report["target_state_file"],
+            split_report["target_state_file"] + ".wal",
+        )
+        live = {u for sh in state._shards for u in sh._users}
+        moved = {u for sh in target._shards for u in sh._users}
+        overlap = live & moved
+        union = live | moved
+        if overlap:
+            violations.append(f"{len(overlap)} users on BOTH partitions")
+        lost = [u for u in seeded + acked if u not in union]
+        if lost:
+            violations.append(
+                f"acked-write loss: {len(lost)} registrations on neither "
+                f"partition (e.g. {lost[:3]})"
+            )
+        if fleet.map.version != 2:
+            violations.append("split map v2 was not adopted in-process")
+        if redirected == 0:
+            violations.append("no post-flip redirects: the split did not "
+                              "land mid-traffic")
+        return {
+            "leg": "split",
+            "seeded_users": users,
+            "acked_during_storm": len(acked),
+            "redirected_after_flip": redirected,
+            "moved_users": split_report["moved_users"],
+            "moved_records": split_report["moved_records"],
+            "map_version": fleet.map.version,
+            "last_decisions": controller.status()["decisions"][-4:],
+            "violations": violations,
+        }
+    finally:
+        if not args.keep_state:
+            shutil.rmtree(state_dir, ignore_errors=True)
+
+
+async def storm_crashloop(args) -> dict:
+    """Ingest-shard crash-loop: kill one shard through its backoff
+    schedule until the supervisor gives up; serving must continue."""
+    from cpzk_tpu.client import AuthClient
+
+    users = min(args.storm_users, 1000)
+    state_dir = tempfile.mkdtemp(prefix="cpzk-storm-crash-")
+    port, ops = args.port + 4, args.ops_port + 4
+    address = f"127.0.0.1:{port}"
+    violations: list[str] = []
+    env = daemon_env(state_dir, users, ops)
+    env["SERVER_INGEST_SHARDS"] = "2"
+    proc = spawn_daemon(port, env, os.path.join(state_dir, "daemon.log"))
+    try:
+        wait_healthy(ops)
+        deadline = time.monotonic() + 60.0
+        while time.monotonic() < deadline:
+            rows = (ops_json(ops, "/statusz").get("ingest") or {}) \
+                .get("per_shard") or []
+            if rows and all(r.get("connected") for r in rows):
+                break
+            await asyncio.sleep(0.2)
+        rng, provers, y1s, y2s = build_corpus()
+        await register_users(address, users, y1s, y2s)
+
+        ok = errs = 0
+        stop = asyncio.Event()
+        # sessions are capped at MAX_SESSIONS_PER_USER=5 (reference
+        # parity): the throttled traffic loop cycles the front of the
+        # corpus and the post-storm check gets its own reserved tail, so
+        # neither exhausts a user's session quota
+        traffic_pool = max(1, users - 20)
+
+        async def traffic():
+            nonlocal ok, errs
+            k = 0
+            client = AuthClient(address)
+            try:
+                while not stop.is_set():
+                    uid_n = k % traffic_pool
+                    try:
+                        good = await _full_login(
+                            client, f"su{uid_n}", provers[uid_n % POOL], rng,
+                        )
+                        ok += 1 if good else 0
+                        errs += 0 if good else 1
+                    except asyncio.CancelledError:
+                        raise
+                    except Exception:  # noqa: BLE001 - shard mid-death
+                        errs += 1
+                    k += 1
+                    await asyncio.sleep(0.05)
+            finally:
+                await client.close()
+
+        tr = asyncio.ensure_future(traffic())
+        kills = 0
+        seen_pids: set[int] = set()
+        crashloop = False
+        deadline = time.monotonic() + 120.0
+        while time.monotonic() < deadline:
+            st = ops_json(ops, "/statusz").get("ingest") or {}
+            if st.get("crashloop_shards", 0) >= 1:
+                crashloop = True
+                break
+            row = (st.get("per_shard") or [{}])[0]
+            pid = row.get("pid")
+            if pid and pid not in seen_pids:
+                seen_pids.add(pid)
+                try:
+                    os.kill(pid, signal.SIGKILL)
+                    kills += 1
+                except ProcessLookupError:
+                    pass
+            await asyncio.sleep(0.2)
+        if not crashloop:
+            violations.append(
+                f"crash-loop guard never tripped after {kills} SIGKILLs"
+            )
+
+        # serving must continue on the surviving shard, no human action
+        post_fail = 0
+        check_errors: list[str] = []
+        async with AuthClient(address) as checker:
+            for j in range(20):
+                k = (traffic_pool + j) % users
+                try:
+                    if not await _full_login(
+                        checker, f"su{k}", provers[k % POOL], rng,
+                        timeout=5.0,
+                    ):
+                        post_fail += 1
+                        check_errors.append("login not successful")
+                except Exception as e:  # noqa: BLE001
+                    post_fail += 1
+                    check_errors.append(repr(e)[:200])
+        if post_fail:
+            violations.append(
+                f"{post_fail}/20 logins failed after the crash-loop "
+                "(the surviving shard stopped serving): "
+                f"{check_errors[0]}"
+            )
+        stop.set()
+        await tr
+        scraped = scrape_metrics(ops)
+        crash_ctr = scraped.get(
+            "ingest_shard_crashloop_total",
+            scraped.get("ingest_shard_crashloop", 0.0),
+        )
+        if crash_ctr < 1 and crashloop:
+            violations.append("ingest.shard.crashloop counter never "
+                              "incremented")
+        burn = errs / max(1, ok + errs)
+        return {
+            "leg": "crashloop",
+            "users": users,
+            "shard_kills": kills,
+            "crashloop_tripped": crashloop,
+            "storm_logins_ok": ok,
+            "storm_login_errors": errs,
+            "storm_burn": round(burn, 5),
+            "post_crashloop_login_failures": post_fail,
+            "crashloop_counter": crash_ctr,
+            "violations": violations,
+        }
+    finally:
+        if proc.poll() is None:
+            proc.send_signal(signal.SIGTERM)
+        try:
+            proc.wait(timeout=30)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+        if not args.keep_state:
+            shutil.rmtree(state_dir, ignore_errors=True)
+
+
+STORMS = {
+    "herd": storm_herd,
+    "brownout": storm_brownout,
+    "split": storm_split,
+    "crashloop": storm_crashloop,
+}
+
+
+async def run_storms(args) -> int:
+    legs = list(STORMS) if args.storm == "all" else [args.storm]
+    reports: dict[str, dict] = {}
+    violations: list[str] = []
+    for leg in legs:
+        print(f"# storm: {leg}", file=sys.stderr, flush=True)
+        report = await STORMS[leg](args)
+        reports[leg] = report
+        violations.extend(f"{leg}: {v}" for v in report.get("violations", []))
+    print(json.dumps({
+        "metric": "storm",
+        "legs": reports,
+        "violations": violations,
+    }), flush=True)
+    if violations:
+        for v in violations:
+            print(f"# VIOLATION {v}", file=sys.stderr, flush=True)
+    return 1 if violations else 0
+
+
 # -- main ---------------------------------------------------------------------
 
 
@@ -571,7 +1269,21 @@ def main() -> int:
     ap.add_argument("--keep-state", action="store_true")
     ap.add_argument("--strict", action="store_true",
                     help="exit nonzero when any soak op errored")
+    ap.add_argument("--storm", default=None,
+                    choices=["herd", "brownout", "split", "crashloop", "all"],
+                    help="run the failure-storm scenario suite instead of "
+                         "the throughput soak (nonzero exit on any "
+                         "invariant violation)")
+    ap.add_argument("--storm-users", type=int, default=2000,
+                    help="registered corpus per storm leg")
+    ap.add_argument("--storm-clients", type=int, default=8,
+                    help="herd leg: damped clients "
+                         f"(x{HERD_WORKERS_PER_CLIENT} login workers each)")
+    ap.add_argument("--storm-duration", type=float, default=5.0,
+                    help="herd leg: post-recovery soak window seconds")
     args = ap.parse_args()
+    if args.storm:
+        return asyncio.run(run_storms(args))
     return asyncio.run(amain(args))
 
 

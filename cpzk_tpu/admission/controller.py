@@ -109,6 +109,10 @@ class AdmissionController:
             clock=clock,
         )
         self.level = float(N_TIERS)  # boot admitting everything
+        self.level_cap = float(N_TIERS)  # fleet-controller bias: the AIMD
+                                         # level can recover only up to
+                                         # this while an SLO burn page is
+                                         # shedding load ahead of cascade
         self._lock = threading.Lock()
         self._last_adjust = clock()
         self._last_wait_count, self._last_wait_sum = metrics.read_histogram(
@@ -159,6 +163,7 @@ class AdmissionController:
                 self.level = max(MIN_LEVEL, self.level * s.decrease_factor)
             elif healthy:
                 self.level = min(float(N_TIERS), self.level + s.increase_step)
+            self.level = min(self.level, self.level_cap)
             changed = self.level != old
         if changed:
             metrics.gauge("admission.level").set(self.level)
@@ -170,6 +175,22 @@ class AdmissionController:
                 utilization=round(util, 3),
                 queue_wait_ms=round(wait * 1000.0, 3),
             )
+
+    def set_level_cap(self, cap: float) -> float:
+        """Clamp the admission level's recovery ceiling (the fleet
+        controller's burn-page actuator).  The cap itself is clamped to
+        ``[MIN_LEVEL, N_TIERS]`` — the controller can never bias tier-0
+        logins out — and an already-higher level drops to it immediately.
+        Returns the applied cap."""
+        cap = min(float(N_TIERS), max(MIN_LEVEL, float(cap)))
+        with self._lock:
+            self.level_cap = cap
+            old = self.level
+            self.level = min(self.level, cap)
+            changed = self.level != old
+        if changed:
+            metrics.gauge("admission.level").set(self.level)
+        return cap
 
     # -- admission ----------------------------------------------------------
 
@@ -261,6 +282,7 @@ class AdmissionController:
         ]
         return {
             "level": self.level,
+            "level_cap": self.level_cap,
             "admitted_tiers": admitted_tiers,
             "clients": len(self.buckets),
             "max_clients": self.buckets.max_keys,

@@ -129,6 +129,9 @@ class AuthClient:
         client_id: str | None = None,
         partition_map: PartitionMap | None = None,
         map_refresh=None,
+        refresh_jitter_s: float = 0.25,
+        refresh_min_interval_s: float = 1.0,
+        reconnect_damp_s: float = 0.5,
     ):
         self.pb2 = load_pb2()
         self.retry = retry
@@ -148,6 +151,23 @@ class AuthClient:
         self.map_refresh = map_refresh
         #: wrong-partition re-routes performed (observability/tests).
         self.redirects = 0
+        # herd damping: N clients waking together (a promotion, a map
+        # flip) must not hammer /partitionmap or the new primary in one
+        # synchronized wave.  Map refreshes are SINGLE-FLIGHT (concurrent
+        # callers share one in-flight fetch) behind a full-jitter delay
+        # and a min re-fetch interval; the first RPC to an address that
+        # just answered UNAVAILABLE sleeps full jitter before re-dialing.
+        self.refresh_jitter_s = refresh_jitter_s
+        self.refresh_min_interval_s = refresh_min_interval_s
+        self.reconnect_damp_s = reconnect_damp_s
+        self._refresh_inflight: asyncio.Task | None = None
+        self._refresh_done_at = float("-inf")
+        #: address -> loop time of the last UNAVAILABLE from it
+        self._addr_down: dict[str, float] = {}
+        #: damping observability (tests + bench assertions)
+        self.refresh_fetches = 0
+        self.refresh_coalesced = 0
+        self.reconnects_damped = 0
         # injectable RNG so chaos tests get deterministic jitter
         self._retry_rng = retry_rng or random.Random()
         self._credentials = credentials
@@ -208,25 +228,90 @@ class AuthClient:
         return self.partition_map.partition_for(user_id).address
 
     async def _refresh_map(self) -> bool:
-        """One bounded map refresh (called on a redirect): adopt the
-        fetched map when its version is strictly newer.  A refresh
-        failure is non-fatal — the redirect's owner trailer still routes
-        this attempt."""
-        fn = self.map_refresh
-        if fn is None:
+        """One bounded, HERD-DAMPED map refresh (called on a redirect):
+        adopt the fetched map when its version is strictly newer.  A
+        refresh failure is non-fatal — the redirect's owner trailer still
+        routes this attempt.
+
+        Damping: concurrent callers coalesce onto ONE in-flight fetch
+        (single-flight), the fetch itself starts behind a full-jitter
+        delay of up to ``refresh_jitter_s``, and a refresh that completed
+        within ``refresh_min_interval_s`` answers from that result
+        instead of re-fetching — so a thousand clients redirected by the
+        same map flip produce a trickle of ``/partitionmap`` hits, not a
+        synchronized wave."""
+        if self.map_refresh is None:
             return False
+        loop = asyncio.get_running_loop()
+        task = self._refresh_inflight
+        if task is None:
+            if (
+                loop.time() - self._refresh_done_at
+                < self.refresh_min_interval_s
+            ):
+                return False  # a fresh-enough fetch already answered
+            task = loop.create_task(self._do_refresh())
+            self._refresh_inflight = task
+        else:
+            self.refresh_coalesced += 1
+        # shield: one caller being cancelled must not kill the fetch the
+        # coalesced others are waiting on
         try:
-            fresh = fn()
+            return await asyncio.shield(task)
+        except asyncio.CancelledError:
+            raise
+        except Exception:
+            return False
+
+    async def _do_refresh(self) -> bool:
+        try:
+            if self.refresh_jitter_s > 0:
+                await asyncio.sleep(
+                    self._retry_rng.uniform(0.0, self.refresh_jitter_s)
+                )
+            self.refresh_fetches += 1
+            fresh = self.map_refresh()
             if asyncio.iscoroutine(fresh):
                 fresh = await fresh
         except Exception:
-            return False
+            fresh = None
+        finally:
+            self._refresh_done_at = asyncio.get_running_loop().time()
+            self._refresh_inflight = None
         if fresh is None or self.partition_map is None:
             return False
         if fresh.version > self.partition_map.version:
             self.partition_map = fresh
             return True
         return False
+
+    def _mark_down(self, address: str | None) -> None:
+        if address:
+            self._addr_down[address] = asyncio.get_running_loop().time()
+
+    async def _damp_reconnect(self, address: str | None) -> None:
+        """Full-jitter sleep before the first RPC back to an address that
+        just answered UNAVAILABLE, so N clients reconnecting after a
+        failover spread their re-dials over ``reconnect_damp_s`` instead
+        of landing on the new primary as one thundering herd.  One damped
+        attempt per down-mark: the mark clears after the sleep (steady
+        traffic is never taxed) and a still-down address re-marks on the
+        next failure."""
+        if not address or self.reconnect_damp_s <= 0:
+            return
+        since = self._addr_down.get(address)
+        if since is None:
+            return
+        loop = asyncio.get_running_loop()
+        if loop.time() - since > self.reconnect_damp_s:
+            # the outage mark is stale — the herd window has passed
+            self._addr_down.pop(address, None)
+            return
+        self._addr_down.pop(address, None)
+        self.reconnects_damped += 1
+        await asyncio.sleep(
+            self._retry_rng.uniform(0.0, self.reconnect_damp_s)
+        )
 
     async def close(self) -> None:
         for ch in self._pool.values():
@@ -242,7 +327,7 @@ class AuthClient:
 
     async def _call(
         self, name: str, stub, request, timeout: float | None,
-        user_id: str | None = None,
+        user_id: str | None = None, address: str | None = None,
     ):
         """One RPC through the routing + retry stack.
 
@@ -282,7 +367,13 @@ class AuthClient:
         policy = self.retry
         routed = self.partition_map is not None and user_id is not None
         if routed:
-            stub = self._stub(self._route_address(user_id), name)
+            address = self._route_address(user_id)
+            stub = self._stub(address, name)
+        elif address is None:
+            address = self._target
+        # post-failover herd damping: jittered hold-off before re-dialing
+        # an address whose last answer was UNAVAILABLE
+        await self._damp_reconnect(address)
         redirected = 0
         while True:
             try:
@@ -292,6 +383,8 @@ class AuthClient:
             except grpc.RpcError as e:
                 code = e.code()
                 code_name = code.name if code is not None else ""
+                if code_name == "UNAVAILABLE":
+                    self._mark_down(address)
                 if (
                     self.partition_map is not None
                     and code_name == "FAILED_PRECONDITION"
@@ -315,6 +408,7 @@ class AuthClient:
                             # (possibly itself-stale) rejecting server
                             addr = self._route_address(user_id)
                         stub = self._stub(addr, name)
+                        address = addr
                         rctx = rctx.child()  # same trace id, attempt + 1
                         self.last_context = rctx
                         continue
@@ -336,6 +430,7 @@ class AuthClient:
                 continue
             if policy is not None and name in _RETRY_SAFE:
                 policy.note_success()
+            self._addr_down.pop(address, None)
             return response
 
     def _metadata(self, rctx: RequestContext):
@@ -396,6 +491,7 @@ class AuthClient:
                     y2_values=[y2_values[i] for i in idxs],
                 ),
                 timeout,
+                address=address,
             )
             for k, i in enumerate(idxs):
                 results[i] = resp.results[k]
@@ -453,6 +549,7 @@ class AuthClient:
                     proofs=[proofs[i] for i in idxs],
                 ),
                 timeout,
+                address=address,
             )
             for k, i in enumerate(idxs):
                 results[i] = resp.results[k]

@@ -81,6 +81,7 @@ class OpsSources:
     slo: object | None = None          # SloEngine
     fleet: object | None = None        # fleet.FleetRouter
     ingest: object | None = None       # server.ingest.IngestSupervisor
+    controller: object | None = None   # fleet.controller.FleetController
     config_fingerprint: str = ""
     role: str = "server"               # "server" | "standby" | "audit"
     started_at: float = field(default_factory=time.monotonic)
@@ -196,6 +197,14 @@ class OpsSources:
         # has answered (map version/digest spot drift across the fleet)
         fleet = self.fleet
         doc["fleet"] = fleet.status() if fleet is not None else None
+
+        # fleet controller: mode (dry-run vs live), cooldowns in flight,
+        # administratively drained lanes, and the last-N decision ring —
+        # the primary "what did the controller just do and why" surface
+        controller = self.controller
+        doc["controller"] = (
+            controller.status() if controller is not None else None
+        )
 
         # sharded ingest: one row per SO_REUSEPORT listener process
         # (pid, connected, rpcs/streams handled, native parses vs

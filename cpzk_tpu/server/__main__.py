@@ -263,6 +263,7 @@ HELP = """Available commands:
   /promote            promote this standby to primary (operator failover)
   /fleet [reload] (/fl) partition-map status; `reload` re-reads the map
                       file and adopts a strictly newer version (splits)
+  /controller  (/ctl) fleet controller: mode, cooldowns, last decisions
   /users       (/u)   registered user count
   /sessions    (/s)   active session count
   /challenges  (/c)   pending challenge count
@@ -275,6 +276,7 @@ HELP = """Available commands:
 async def handle_command(
     cmd: str, state: ServerState, backend=None, durability=None,
     admission=None, replication=None, audit_log=None, fleet=None,
+    controller=None,
 ) -> tuple[str, bool]:
     """(output, should_quit) for one REPL line (server.rs:50-90,261-359).
     ``backend`` is the serving FailoverBackend (None on the inline CPU
@@ -473,6 +475,39 @@ async def handle_command(
             f" redirects={s['redirects']}",
             False,
         )
+    if word in ("/controller", "/ctl"):
+        if controller is None:
+            return (
+                "fleet controller disabled (set [controller] enabled = true "
+                "to close the signal->actuator loop; dry_run = true to "
+                "watch decisions without acting)",
+                False,
+            )
+        s = controller.status()
+        lines = [
+            f"mode={'DRY-RUN' if s['dry_run'] else 'LIVE'}"
+            f" ticks={s['ticks']}"
+            f" acting={s['acting']}"
+            f" drained_lanes={','.join(s['drained_lanes']) or 'none'}"
+            + (
+                " cooldowns=" + " ".join(
+                    f"{k}:{v:.0f}s" for k, v in s["cooldowns_s"].items()
+                ) if s["cooldowns_s"] else ""
+            )
+        ]
+        for row in list(s["decisions"])[-5:]:
+            outcome = (
+                "FIRED" if row["fired"]
+                else f"veto:{row['veto']}" if row["veto"]
+                else "dry-run"
+            )
+            lines.append(
+                f"  {row['action']} {row['target']} [{outcome}] "
+                f"{row['reason']}"
+            )
+        if len(lines) == 1:
+            lines.append("  (no decisions yet)")
+        return "\n".join(lines), False
     if word == "/promote":
         if replication is None or not hasattr(replication, "promote"):
             return (
@@ -828,6 +863,51 @@ async def amain(args) -> None:
     # late attachments: serve() built these (health gate, stream registry)
     ops_sources.health = server.health
     ops_sources.service = server.auth_service
+
+    # fleet controller ([controller] enabled): the self-driving loop over
+    # the planes built above — started after serve() so its first tick
+    # already sees the lane router and ingest shards, dry-run by default
+    controller = None
+    controller_task = None
+    if config.controller.enabled:
+        from ..fleet.controller import FleetController
+
+        controller = FleetController(
+            config.controller,
+            state=state,
+            router=getattr(batcher, "router", None),
+            admission=admission,
+            slo=slo_engine,
+            fleet=fleet_router,
+            durability=durability,
+            replica=replica,
+            epoch_file=config.replication.epoch_file
+            or ((config.state_file + ".epoch") if config.state_file else ""),
+            segment_bytes=config.replication.segment_bytes,
+        )
+        ops_sources.controller = controller
+
+        async def controller_ticker() -> None:
+            interval = config.controller.tick_interval_ms / 1000.0
+            while not stop.is_set():
+                try:
+                    await asyncio.wait_for(stop.wait(), timeout=interval)
+                    return
+                except asyncio.TimeoutError:
+                    pass
+                try:
+                    await controller.tick()
+                except Exception:
+                    log.exception("controller tick failed; continuing")
+
+        controller_task = asyncio.create_task(controller_ticker())
+        log.info(
+            "fleet controller %s: tick %gms, act after %d hot ticks, "
+            "clear after %d",
+            "DRY-RUN (decisions only)" if config.controller.dry_run
+            else "LIVE", config.controller.tick_interval_ms,
+            config.controller.act_ticks, config.controller.clear_ticks,
+        )
     if shipper is not None:
         shipper.start()
     if replica is not None:
@@ -875,6 +955,7 @@ async def amain(args) -> None:
             out, quit_ = await handle_command(
                 line, state, backend, durability, admission,
                 shipper or replica, audit_log, fleet_router,
+                controller,
             )
             if out:
                 print(_c("white", out))
@@ -914,6 +995,10 @@ async def amain(args) -> None:
         await ops_plane.stop()
     if metrics_fallback_plane is not None:
         await metrics_fallback_plane.stop()
+    if controller_task is not None:
+        controller_task.cancel()
+        with contextlib.suppress(asyncio.CancelledError):
+            await controller_task
     slo_task.cancel()
     with contextlib.suppress(asyncio.CancelledError):
         await slo_task

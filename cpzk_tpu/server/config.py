@@ -307,6 +307,45 @@ class FleetSettings:
 
 
 @dataclass
+class ControllerSettings:
+    """Self-driving fleet control loop (``fleet/controller.py``): a
+    daemon-resident ticker that consumes the existing observability
+    signals (SLO burn pages, per-shard sizes + lock-wait, lane breaker
+    states) and acts through the existing actuators — live partition
+    split, lane drain/re-admit, admission level cap.  Ships with
+    ``dry_run = true``: decisions are computed, traced, and surfaced on
+    ``/statusz`` but no actuator fires until an operator flips it.  See
+    ``docs/operations.md`` §"Fleet controller & failure storms"."""
+
+    enabled: bool = False
+    tick_interval_ms: float = 1000.0  # signal sampling cadence
+    dry_run: bool = True          # compute + publish decisions, act on none
+    decision_ring: int = 64       # last-N decisions kept for /statusz
+    # two-sided hysteresis: a signal must stay hot for act_ticks
+    # consecutive ticks before the action fires, and stay clear for
+    # clear_ticks consecutive ticks before the reverse action (lane
+    # re-admit, admission cap restore) fires — the controller cannot flap
+    act_ticks: int = 3
+    clear_ticks: int = 5
+    # live partition split: fires when THIS partition's user count or
+    # sustained mean shard lock-wait crosses its capacity envelope
+    # (calibrate both from the soak harness; 0 = that trigger disabled)
+    split_user_threshold: int = 0
+    split_lock_wait_ms: float = 0.0
+    split_target_address: str = ""  # address the new partition will own in
+                                    # the flipped map; empty disarms splits
+    split_cooldown_s: float = 600.0
+    # lane drain: fires when a lane breaker stays OPEN this long;
+    # re-admit once the breaker has been CLOSED for clear_ticks ticks
+    lane_open_after_s: float = 10.0
+    lane_cooldown_s: float = 30.0   # min seconds a drained lane stays out
+    # admission bias: cap the AIMD level one tier down per shrink while a
+    # login SLO burn page is firing; restore tier-by-tier on clear ticks
+    slo_rpc: str = "VerifyProof"    # the RPC whose burn pages drive it
+    admission_cooldown_s: float = 15.0
+
+
+@dataclass
 class AdmissionSettings:
     """Adaptive overload control (admission subsystem): per-client keyed
     token buckets in an LRU-bounded table, DAGOR-style priority-aware
@@ -384,6 +423,7 @@ class ServerConfig:
     opsplane: OpsplaneSettings = field(default_factory=OpsplaneSettings)
     slo: SloSettings = field(default_factory=SloSettings)
     fleet: FleetSettings = field(default_factory=FleetSettings)
+    controller: ControllerSettings = field(default_factory=ControllerSettings)
 
     def addr(self) -> str:
         return f"{self.host}:{self.port}"
@@ -435,6 +475,7 @@ class ServerConfig:
             ("opsplane", self.opsplane),
             ("slo", self.slo),
             ("fleet", self.fleet),
+            ("controller", self.controller),
         ):
             for key, value in data.get(section, {}).items():
                 if hasattr(obj, key):
@@ -644,6 +685,35 @@ class ServerConfig:
             self.fleet.partition = int(v)
         if (v := get("FLEET_ADVERTISE")) is not None:
             self.fleet.advertise = v
+        # controller knobs (self-driving fleet control loop)
+        if (v := get("CONTROLLER_ENABLED")) is not None:
+            self.controller.enabled = v.lower() in ("1", "true", "yes", "on")
+        if (v := get("CONTROLLER_TICK_INTERVAL_MS")) is not None:
+            self.controller.tick_interval_ms = float(v)
+        if (v := get("CONTROLLER_DRY_RUN")) is not None:
+            self.controller.dry_run = v.lower() in ("1", "true", "yes", "on")
+        if (v := get("CONTROLLER_DECISION_RING")) is not None:
+            self.controller.decision_ring = int(v)
+        if (v := get("CONTROLLER_ACT_TICKS")) is not None:
+            self.controller.act_ticks = int(v)
+        if (v := get("CONTROLLER_CLEAR_TICKS")) is not None:
+            self.controller.clear_ticks = int(v)
+        if (v := get("CONTROLLER_SPLIT_USER_THRESHOLD")) is not None:
+            self.controller.split_user_threshold = int(v)
+        if (v := get("CONTROLLER_SPLIT_LOCK_WAIT_MS")) is not None:
+            self.controller.split_lock_wait_ms = float(v)
+        if (v := get("CONTROLLER_SPLIT_TARGET_ADDRESS")) is not None:
+            self.controller.split_target_address = v
+        if (v := get("CONTROLLER_SPLIT_COOLDOWN_S")) is not None:
+            self.controller.split_cooldown_s = float(v)
+        if (v := get("CONTROLLER_LANE_OPEN_AFTER_S")) is not None:
+            self.controller.lane_open_after_s = float(v)
+        if (v := get("CONTROLLER_LANE_COOLDOWN_S")) is not None:
+            self.controller.lane_cooldown_s = float(v)
+        if (v := get("CONTROLLER_SLO_RPC")) is not None:
+            self.controller.slo_rpc = v
+        if (v := get("CONTROLLER_ADMISSION_COOLDOWN_S")) is not None:
+            self.controller.admission_cooldown_s = float(v)
 
     # --- validation (config.rs:238-273) ---
 
@@ -903,6 +973,51 @@ class ServerConfig:
             raise ValueError(
                 "fleet.partition must be a partition index, or -1 to "
                 "discover it from the advertise address"
+            )
+        if self.controller.tick_interval_ms <= 0:
+            raise ValueError("controller.tick_interval_ms must be positive")
+        if self.controller.decision_ring < 1:
+            raise ValueError("controller.decision_ring must be >= 1")
+        if self.controller.act_ticks < 1 or self.controller.clear_ticks < 1:
+            raise ValueError(
+                "controller.act_ticks and controller.clear_ticks must be "
+                ">= 1 (the hysteresis windows cannot be empty)"
+            )
+        if self.controller.split_user_threshold < 0:
+            raise ValueError(
+                "controller.split_user_threshold cannot be negative "
+                "(0 disables the user-count split trigger)"
+            )
+        if self.controller.split_lock_wait_ms < 0:
+            raise ValueError(
+                "controller.split_lock_wait_ms cannot be negative "
+                "(0 disables the lock-wait split trigger)"
+            )
+        if min(
+            self.controller.split_cooldown_s,
+            self.controller.lane_cooldown_s,
+            self.controller.admission_cooldown_s,
+        ) < 0:
+            raise ValueError("controller cooldowns cannot be negative")
+        if self.controller.lane_open_after_s <= 0:
+            raise ValueError("controller.lane_open_after_s must be positive")
+        if not self.controller.slo_rpc:
+            raise ValueError(
+                "controller.slo_rpc must name the RPC whose burn pages "
+                "drive the admission action"
+            )
+        if (
+            self.controller.enabled
+            and (
+                self.controller.split_user_threshold > 0
+                or self.controller.split_lock_wait_ms > 0
+            )
+            and not self.controller.split_target_address
+        ):
+            raise ValueError(
+                "controller split triggers are armed but "
+                "controller.split_target_address is empty (the flipped map "
+                "needs an address for the new partition)"
             )
         try:
             buckets = self.observability.parsed_buckets()

@@ -29,8 +29,12 @@ entered in-process or through any shard.
 Failure model: a shard is stateless — SIGKILL one and its open
 connections reset (clients retry per their policy), the daemon keeps
 serving through the remaining shards, and the supervisor respawns the
-dead shard within a poll tick (``ingest.shard.respawns``).  The
-dispatch process dying takes the service down exactly like today.
+dead shard with exponential full-jitter backoff
+(``ingest.shard.respawns``).  A shard that keeps dying trips the
+crash-loop guard — N deaths in M seconds and the supervisor abandons it
+(``ingest.shard.crashloop``, ``crashloop`` marker in /statusz) instead
+of spinning forever on a doomed binary.  The dispatch process dying
+takes the service down exactly like today.
 
 ``ingest_shards = 1`` never constructs any of this (spy-pinned): the
 daemon binds in-process and the hot path is byte-identical to the
@@ -44,6 +48,7 @@ import contextlib
 import logging
 import os
 import pickle
+import random
 import tempfile
 import time
 
@@ -196,6 +201,10 @@ class IngestSupervisor:
         wire: str = "native",
         tls: tuple[bytes, bytes] | None = None,
         uds_dir: str | None = None,
+        backoff_base_s: float = 0.5,
+        backoff_max_s: float = 30.0,
+        crashloop_deaths: int = 5,
+        crashloop_window_s: float = 60.0,
     ):
         from .proto import load_pb2, method_types, stream_method_types
         from .service import request_deserializers
@@ -217,11 +226,22 @@ class IngestSupervisor:
         self._monitor: asyncio.Task | None = None
         self._stopping = False
         self.respawns = 0
+        # crash-loop guard: dead shards respawn with exponential full-jitter
+        # backoff, and crashloop_deaths deaths inside crashloop_window_s
+        # stop the respawning entirely — a bad shard binary (bad port, bad
+        # TLS material, instant-exit bug) must not spin the supervisor
+        self.backoff_base_s = backoff_base_s
+        self.backoff_max_s = backoff_max_s
+        self.crashloop_deaths = crashloop_deaths
+        self.crashloop_window_s = crashloop_window_s
+        self._death_times: dict[int, list[float]] = {}
+        self._respawn_at: dict[int, float] = {}
+        self._backoff_rng = random.Random()  # injectable for deterministic tests
         #: per-shard counters behind /statusz (index -> row dict)
         self.shard_stats: dict[int, dict] = {
             i: {"shard": i, "pid": None, "connected": False, "rpcs": 0,
                 "streams": 0, "parses": 0, "fallbacks": 0, "errors": 0,
-                "respawns": 0}
+                "respawns": 0, "crashloop": False}
             for i in range(shards)
         }
 
@@ -292,24 +312,64 @@ class IngestSupervisor:
         self.shard_stats[index]["pid"] = proc.pid
 
     async def _monitor_loop(self) -> None:
-        """Respawn dead shards (SIGKILL, OOM, crash) within a poll tick;
-        one shard dying only resets its own connections."""
+        """Respawn dead shards (SIGKILL, OOM, crash) with exponential
+        full-jitter backoff; one shard dying only resets its own
+        connections, and a shard that keeps dying (``crashloop_deaths``
+        deaths inside ``crashloop_window_s``) is abandoned — marked
+        ``crashloop`` in /statusz, counted once, never respawned again —
+        so the daemon keeps serving on the healthy shards instead of
+        burning the supervisor on a doomed binary."""
         while not self._stopping:
             await asyncio.sleep(0.5)
+            now = time.monotonic()
             for index, proc in list(self._procs.items()):
                 if self._stopping or proc.is_alive():
                     continue
                 code = proc.exitcode
                 await asyncio.to_thread(proc.join, 1.0)
+                del self._procs[index]
+                self.shard_stats[index]["connected"] = False
+                self._on_shard_death(index, proc.pid, code, now)
+            for index, due in list(self._respawn_at.items()):
+                if self._stopping or now < due:
+                    continue
+                del self._respawn_at[index]
                 self.respawns += 1
                 self.shard_stats[index]["respawns"] += 1
-                self.shard_stats[index]["connected"] = False
                 metrics.counter("ingest.shard.respawns").inc()
-                log.warning(
-                    "ingest shard %d (pid %s) died with exit code %s; "
-                    "respawning", index, proc.pid, code,
-                )
                 self._spawn(index)
+
+    def _on_shard_death(self, index: int, pid, code, now: float) -> None:
+        """One shard death: record it, then either give up (crash-loop)
+        or schedule a jittered respawn."""
+        deaths = self._death_times.setdefault(index, [])
+        deaths.append(now)
+        cutoff = now - self.crashloop_window_s
+        while deaths and deaths[0] < cutoff:
+            deaths.pop(0)
+        if len(deaths) >= self.crashloop_deaths:
+            self.shard_stats[index]["crashloop"] = True
+            metrics.counter("ingest.shard.crashloop").inc()
+            log.warning(
+                "ingest shard %d (pid %s) crash-looping: %d deaths in "
+                "%.0fs (last exit code %s) — giving up on this shard; "
+                "the daemon keeps serving on the remaining %d",
+                index, pid, len(deaths), self.crashloop_window_s, code,
+                sum(1 for p in self._procs.values() if p.is_alive()),
+            )
+            return
+        ceiling = min(
+            self.backoff_max_s,
+            self.backoff_base_s * (2 ** (len(deaths) - 1)),
+        )
+        delay = self._backoff_rng.uniform(0.0, ceiling)  # full jitter
+        self._respawn_at[index] = now + delay
+        log.warning(
+            "ingest shard %d (pid %s) died with exit code %s; respawn "
+            "in %.2fs (death %d/%d in the last %.0fs)",
+            index, pid, code, delay, len(deaths),
+            self.crashloop_deaths, self.crashloop_window_s,
+        )
 
     async def stop(self) -> None:
         self._stopping = True
@@ -337,6 +397,10 @@ class IngestSupervisor:
         return {
             "shards": self.shards,
             "respawns": self.respawns,
+            "crashloop_shards": sum(
+                1 for i in range(self.shards)
+                if self.shard_stats[i].get("crashloop")
+            ),
             "per_shard": [
                 dict(self.shard_stats[i]) for i in range(self.shards)
             ],

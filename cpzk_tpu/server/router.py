@@ -89,6 +89,10 @@ class _LaneSlot:
     drained_at: float | None = None
     probes: int = 0
     stages_lane: int | str = 0
+    drained: bool = False     # administratively out of rotation (the
+                              # fleet controller's brownout actuator);
+                              # distinct from the breaker, which keeps
+                              # probing a drained lane's sick backend
 
     def note_drain(self, n: int, now: float) -> None:
         if self.drained_at is not None:
@@ -240,18 +244,29 @@ class LaneRouter:
         :meth:`submit` before this runs; here only the per-device lanes
         compete."""
         with self._lock:
+            # a drained lane takes no PRIMARY placement but still gets its
+            # HALF_OPEN probes — recovery must stay provable while the
+            # fleet controller holds the lane out of rotation, or it could
+            # never earn re-admission.
             routable: list[_LaneSlot] = []
             probe: _LaneSlot | None = None
             for slot in self._slots:
                 route = slot.breaker.acquire()
                 if route == ROUTE_PRIMARY:
-                    routable.append(slot)
+                    if not slot.drained:
+                        routable.append(slot)
                 elif route == ROUTE_PROBE and probe is None:
                     probe = slot  # this batch becomes the lane's probe
             if probe is not None:
                 probe.probes += 1
                 return probe, True
-            pool = routable or self._slots  # all OPEN: route anyway
+            # all OPEN (or all drained): route anyway — refusing every
+            # batch is strictly worse than trying the sick pool
+            pool = (
+                routable
+                or [s for s in self._slots if not s.drained]
+                or self._slots
+            )
             if not routable:
                 metrics.counter("tpu.lane.all_open").inc()
             rates = [s.drain_rate for s in pool if s.drain_rate > 0]
@@ -389,7 +404,53 @@ class LaneRouter:
             "pending_entries": slot.pending,
             "queued_batches": ingress + staged,
             "drain_rate_per_s": round(slot.drain_rate, 3),
+            "drained": slot.drained,
         }
+
+    # -- administrative drain (fleet controller actuator) --------------------
+
+    def lane_states(self) -> list[dict]:
+        """Per-device lane signal rows for the fleet controller: label,
+        breaker state, drained flag, pending depth.  Mesh lane excluded —
+        the controller rebalances the per-device pool only."""
+        with self._lock:
+            return [
+                {
+                    "lane": s.label,
+                    "breaker": s.breaker.state.value,
+                    "drained": s.drained,
+                    "pending": s.pending,
+                }
+                for s in self._slots
+            ]
+
+    def drain_lane(self, label: str) -> bool:
+        """Take one per-device lane out of placement rotation (its pending
+        work still settles; new batches rebalance across siblings).  True
+        when the flag flipped, False for unknown labels or no-ops."""
+        return self._set_drained(label, True)
+
+    def readmit_lane(self, label: str) -> bool:
+        """Put a drained lane back in rotation.  The breaker still rules:
+        a re-admitted lane whose backend is sick re-opens on its own."""
+        return self._set_drained(label, False)
+
+    def _set_drained(self, label: str, drained: bool) -> bool:
+        with self._lock:
+            for slot in self._slots:
+                if slot.label == label and slot.drained != drained:
+                    slot.drained = drained
+                    break
+            else:
+                return False
+        metrics.gauge(
+            "tpu.lane.drained", labelnames=("lane",)
+        ).labels(lane=label).set(1.0 if drained else 0.0)
+        log.warning(
+            "lane %s %s rotation", label,
+            "drained from" if drained else "re-admitted to",
+        )
+        return True
 
     def breakers(self) -> list[CircuitBreaker]:
         """Per-lane breakers, lane order (REPL /reset re-arms them all)."""
