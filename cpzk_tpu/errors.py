@@ -28,3 +28,14 @@ class InvalidProofEncoding(InvalidGroupElement):
     type lets the serving layer report the exact parse-time message
     ("Invalid proof: ...") instead of a generic verification failure, so
     deferred parsing is observationally identical to eager parsing."""
+
+
+class WrongPartition(Error):
+    """A user-keyed mutation reached a partition that no longer owns the
+    user under the live fleet map.  Raised by :class:`ServerState`'s
+    write-time ownership fence (``owner_fence``) when a handler that
+    passed its entry ownership check resumes after a live partition
+    split flipped the map mid-flight; the serving layer answers it with
+    the same ``FAILED_PRECONDITION`` redirect (owner address + map
+    version trailers) as the entry check, so the client re-routes and
+    no acknowledged write ever lands on a stale copy."""

@@ -37,11 +37,19 @@ from its stopped files, but the controller must split a *serving*
 daemon.  The live variant writes the same resumable manifest, then runs
 export → copy → map-flip as one synchronous critical section on the
 event loop — no await between the consistent cut and the ownership flip,
-so no mutating handler can interleave and no acknowledged write can land
-on a stale copy.  The serving pause this buys is proportional to the
-moved subset, which is exactly why the controller fires it *before* the
-capacity cliff rather than at it.  A crash at any point leaves the
-standard manifest; the offline ``fleet split`` resume completes it.
+so no handler can observe a half-exported state.  That alone fences only
+handlers whose ownership check and mutation share one synchronous
+section; a multi-await handler (``VerifyProof`` awaits the batcher
+between its entry check and ``create_session``, ``register`` awaits the
+shard lock) can straddle the flip, which is why every acknowledged
+user-keyed mutation ALSO re-verifies ownership at write time through
+``ServerState.owner_fence`` — inside the shard lock, synchronously with
+the mutation — and answers a post-flip write with the standard redirect
+instead of an ack (see ``server/state.py``).  The serving pause the
+critical section buys is proportional to the moved subset, which is
+exactly why the controller fires it *before* the capacity cliff rather
+than at it.  A crash at any point leaves the standard manifest; the
+offline ``fleet split`` resume completes it.
 """
 
 from __future__ import annotations
@@ -164,6 +172,9 @@ class FleetController:
         self._lane_drained_at: dict[str, float] = {}
         # per-action cooldown stamps (clock time of the last armed action)
         self._cooldown_until: dict[str, float] = {}
+        # undo record of THE decision decide() committed this tick, for
+        # rollback + error backoff when the live actuator raises
+        self._pending_undo: dict | None = None
         # lock-wait histogram baseline for the per-tick delta
         self._lw_count, self._lw_sum = metrics.read_histogram(
             "state.shard.lock_wait"
@@ -209,7 +220,16 @@ class FleetController:
         """Turn one signal snapshot into decisions.  Identical in dry-run
         and live mode: hysteresis counters, cooldown stamps, and the
         decision stream never depend on ``dry_run`` — only the actuator
-        call (which :meth:`tick` performs) does."""
+        call (which :meth:`tick` performs) does.
+
+        The ``_decide_*`` helpers are PURE over the arm state: they
+        accumulate hysteresis and attach veto reasons but never stamp a
+        cooldown or reset a counter.  Only after the single-action rail
+        has picked THE action of this tick does :meth:`_commit` consume
+        its cooldown + hysteresis — so a same-tick runner-up vetoed as
+        ``single-action`` keeps its accumulated eligibility and can fire
+        on the very next tick instead of re-paying a full cooldown plus
+        ``act_ticks`` of re-accumulation for an action that never ran."""
         now = self._clock()
         out: list[Decision] = []
         self._decide_split(sig, now, out)
@@ -220,7 +240,67 @@ class FleetController:
         armed = [d for d in out if d.veto is None]
         for d in armed[1:]:
             d.veto = "single-action"
+        self._pending_undo = None
+        if armed:
+            self._pending_undo = self._commit(armed[0], now)
         return out
+
+    def _commit(self, d: Decision, now: float) -> dict:
+        """Consume the selected action's cooldown + hysteresis — called
+        for exactly ONE decision per tick, after the single-action rail.
+        Returns the undo record :meth:`_rollback` needs when the live
+        actuator subsequently fails."""
+        s = self.settings
+        a, t = d.action, d.target
+        undo: dict = {"action": a, "target": t}
+        if a == ACTION_SPLIT:
+            undo["split_hot"] = self._split_hot
+            self._arm(a, now, s.split_cooldown_s)
+            self._split_hot = 0
+        elif a == ACTION_LANE_DRAIN:
+            undo["open_since"] = self._lane_open_since.pop(t, None)
+            undo["drained_at"] = self._lane_drained_at.get(t)
+            undo["closed_ticks"] = self._lane_closed_ticks.get(t, 0)
+            self._lane_drained_at[t] = now
+            self._lane_closed_ticks[t] = 0
+        elif a == ACTION_LANE_READMIT:
+            undo["drained_at"] = self._lane_drained_at.pop(t, None)
+            undo["closed_ticks"] = self._lane_closed_ticks.get(t, 0)
+            self._lane_closed_ticks[t] = 0
+        elif a in (ACTION_ADMISSION_SHRINK, ACTION_ADMISSION_RESTORE):
+            undo["paging_hot"] = self._paging_hot
+            undo["paging_clear"] = self._paging_clear
+            self._arm(a, now, s.admission_cooldown_s)
+            self._paging_hot = 0
+            self._paging_clear = 0
+        return undo
+
+    def _rollback(self, d: Decision, undo: dict, now: float) -> None:
+        """A live actuator raised: restore the hysteresis/bookkeeping the
+        commit consumed (nothing actually changed in the planes) and
+        replace the full cooldown with the short ``error_backoff_s`` —
+        a transient actuator failure must not block the retry for e.g.
+        the 600 s split cooldown, but the very next tick hammering a
+        broken actuator helps nobody either."""
+        a, t = d.action, d.target
+        if a == ACTION_SPLIT:
+            self._split_hot = undo["split_hot"]
+        elif a == ACTION_LANE_DRAIN:
+            if undo["open_since"] is not None:
+                self._lane_open_since[t] = undo["open_since"]
+            if undo["drained_at"] is None:
+                self._lane_drained_at.pop(t, None)   # it is NOT drained
+            else:
+                self._lane_drained_at[t] = undo["drained_at"]
+            self._lane_closed_ticks[t] = undo["closed_ticks"]
+        elif a == ACTION_LANE_READMIT:
+            if undo["drained_at"] is not None:       # it is STILL drained
+                self._lane_drained_at[t] = undo["drained_at"]
+            self._lane_closed_ticks[t] = undo["closed_ticks"]
+        elif a in (ACTION_ADMISSION_SHRINK, ACTION_ADMISSION_RESTORE):
+            self._paging_hot = undo["paging_hot"]
+            self._paging_clear = undo["paging_clear"]
+        self._arm(a, now, self.settings.error_backoff_s)
 
     def _cooled(self, kind: str, now: float) -> bool:
         return now >= self._cooldown_until.get(kind, 0.0)
@@ -278,9 +358,6 @@ class FleetController:
             d.veto = "action-in-flight"
         elif not self._cooled(ACTION_SPLIT, now):
             d.veto = "cooldown"
-        else:
-            self._arm(ACTION_SPLIT, now, s.split_cooldown_s)
-            self._split_hot = 0
         out.append(d)
 
     def _decide_lanes(
@@ -319,10 +396,9 @@ class FleetController:
                     )
                     if self.acting:
                         d.veto = "action-in-flight"
-                    else:
-                        self._lane_closed_ticks[label] = 0
-                        self._lane_drained_at.pop(label, None)
-                    out.append(d)
+                    elif not self._cooled(ACTION_LANE_READMIT, now):
+                        d.veto = "cooldown"  # error backoff after a failed
+                    out.append(d)            # readmit actuation
                 continue
             if not is_open:
                 self._lane_open_since.pop(label, None)
@@ -344,11 +420,9 @@ class FleetController:
             )
             if self.acting:
                 d.veto = "action-in-flight"
-            else:
-                self._lane_open_since.pop(label, None)
-                self._lane_drained_at[label] = now
-                self._lane_closed_ticks[label] = 0
-            out.append(d)
+            elif not self._cooled(ACTION_LANE_DRAIN, now):
+                d.veto = "cooldown"          # error backoff after a failed
+            out.append(d)                    # drain actuation
         for label in list(self._lane_open_since):
             if label not in seen:
                 del self._lane_open_since[label]
@@ -381,9 +455,6 @@ class FleetController:
                 d.veto = "action-in-flight"
             elif not self._cooled(ACTION_ADMISSION_SHRINK, now):
                 d.veto = "cooldown"
-            else:
-                self._arm(ACTION_ADMISSION_SHRINK, now, s.admission_cooldown_s)
-                self._paging_hot = 0
             out.append(d)
         else:
             self._paging_hot = 0
@@ -407,11 +478,6 @@ class FleetController:
                 d.veto = "action-in-flight"
             elif not self._cooled(ACTION_ADMISSION_RESTORE, now):
                 d.veto = "cooldown"
-            else:
-                self._arm(
-                    ACTION_ADMISSION_RESTORE, now, s.admission_cooldown_s
-                )
-                self._paging_clear = 0
             out.append(d)
 
     # -- the tick ------------------------------------------------------------
@@ -435,6 +501,12 @@ class FleetController:
                 d.fired = True
             except Exception as e:
                 d.veto = f"actuator-error: {e}"
+                # nothing changed in the planes: give the consumed
+                # cooldown + hysteresis back and retry after the short
+                # error backoff instead of a full action cooldown
+                if self._pending_undo is not None:
+                    self._rollback(d, self._pending_undo, self._clock())
+                    self._pending_undo = None
                 log.exception(
                     "controller %s on %s failed", d.action, d.target
                 )
@@ -531,13 +603,24 @@ async def run_live_split(
     trust boundary, same map flip as ``fleet/split.py``, but the source
     is the daemon's live ``ServerState`` instead of stopped files.
 
-    Correctness hinges on one structural property: **export → copy →
-    flip runs with no await point**, so the single-threaded event loop
-    guarantees no mutating handler interleaves between the consistent
-    cut and the ownership flip — an acknowledged write either precedes
-    the export (and ships) or follows the flip (and redirects).  The
-    drain (drop + covering checkpoint) runs after the flip, when
-    ownership enforcement already fences the stale copies.
+    Correctness hinges on two structural properties that together
+    totally order every acknowledged write against the cut:
+
+    1. **export → copy → flip runs with no await point**, so the
+       single-threaded event loop guarantees no handler interleaves
+       between the consistent cut and the ownership flip;
+    2. **every acknowledged user-keyed mutation re-verifies ownership
+       at write time** (``ServerState.owner_fence``, checked inside the
+       shard lock in the same synchronous section as the mutation) —
+       a handler that passed its entry ownership check but resumed
+       from a later await (the batcher, a shard lock) after the flip
+       is answered with the redirect, not an ack.
+
+    An acknowledged write therefore either precedes the export (and
+    ships) or follows the flip (and redirects); nothing acked can land
+    on a stale copy for ``drop_users`` to discard.  The drain (drop +
+    covering checkpoint) runs after the flip, when both fences already
+    reject the moved users.
 
     A crash at any point leaves the standard resumable manifest; the
     offline ``python -m cpzk_tpu.fleet split`` run completes the split

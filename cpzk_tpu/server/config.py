@@ -343,6 +343,11 @@ class ControllerSettings:
     # login SLO burn page is firing; restore tier-by-tier on clear ticks
     slo_rpc: str = "VerifyProof"    # the RPC whose burn pages drive it
     admission_cooldown_s: float = 15.0
+    # retry spacing after an actuator RAISED: the failed action's full
+    # cooldown is rolled back (nothing changed in the planes) and this
+    # short backoff governs the retry instead — a transient split
+    # failure must not burn the 600 s split cooldown
+    error_backoff_s: float = 30.0
 
 
 @dataclass
@@ -714,6 +719,8 @@ class ServerConfig:
             self.controller.slo_rpc = v
         if (v := get("CONTROLLER_ADMISSION_COOLDOWN_S")) is not None:
             self.controller.admission_cooldown_s = float(v)
+        if (v := get("CONTROLLER_ERROR_BACKOFF_S")) is not None:
+            self.controller.error_backoff_s = float(v)
 
     # --- validation (config.rs:238-273) ---
 
@@ -997,6 +1004,7 @@ class ServerConfig:
             self.controller.split_cooldown_s,
             self.controller.lane_cooldown_s,
             self.controller.admission_cooldown_s,
+            self.controller.error_backoff_s,
         ) < 0:
             raise ValueError("controller cooldowns cannot be negative")
         if self.controller.lane_open_after_s <= 0:

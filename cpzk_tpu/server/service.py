@@ -110,6 +110,15 @@ class AuthServiceImpl:
         # per-stream rows and the auth.stream.active gauge
         self._streams: dict[int, dict] = {}
         self._stream_seq = 0
+        # write-time ownership fence: the entry-point _check_owner alone
+        # cannot fence multi-await handlers (VerifyProof awaits the
+        # batcher between its check and create_session) across a live
+        # split's map flip, so state re-verifies ownership INSIDE the
+        # shard lock on every acked user-keyed mutation and raises
+        # errors.WrongPartition — answered below with the same redirect
+        # as the entry check (see ServerState.attach_owner_fence)
+        if fleet is not None and hasattr(state, "attach_owner_fence"):
+            state.attach_owner_fence(self._wrong_partition_counted)
 
     # --- stream registry (ops plane introspection seam) -------------------
 
@@ -234,12 +243,19 @@ class AuthServiceImpl:
         Running this ahead of every state touch is what makes the
         redirect replay-safe even for ``VerifyProof`` — the challenge is
         still unconsumed when the redirect goes out."""
-        msg = self._wrong_partition(user_id)
+        msg = self._wrong_partition_counted(user_id)
         if msg is None:
             return
+        await self._redirect_abort(user_id, context, msg)
+
+    async def _redirect_abort(self, user_id: str, context, msg: str) -> None:
+        """The wrong-partition abort itself (counting is the caller's —
+        or the write-time fence's — job): ``FAILED_PRECONDITION`` with
+        the map version and the owning partition's address in trailing
+        metadata, so a stale-map client can refresh + re-route in one
+        round trip.  Shared by the entry check above and the
+        ``errors.WrongPartition`` handlers on the mutation paths."""
         fleet = self.fleet
-        fleet.redirects += 1
-        metrics.counter("fleet.redirects").inc()
         owner = fleet.owner(user_id)
         md = (
             (PARTITION_MAP_VERSION_KEY, str(fleet.map.version)),
@@ -356,6 +372,10 @@ class AuthServiceImpl:
                     registered_at=int(time.time()),
                 )
             )
+        except errors.WrongPartition as e:
+            # ownership moved between the entry check and the insert (a
+            # live split flipped the map mid-flight): redirect, no ack
+            await self._redirect_abort(request.user_id, context, str(e))
         except errors.Error as e:
             await context.abort(grpc.StatusCode.ALREADY_EXISTS, f"Registration failed: {e}")
 
@@ -447,6 +467,8 @@ class AuthServiceImpl:
         )
         try:
             expires_at = await self.state.create_challenge(user.user_id, challenge_id)
+        except errors.WrongPartition as e:
+            await self._redirect_abort(request.user_id, context, str(e))
         except errors.Error as e:
             # per-user challenge-cap overload: pushback rides along like
             # every other RESOURCE_EXHAUSTED (satellite fix)
@@ -531,6 +553,15 @@ class AuthServiceImpl:
         )
         try:
             await self.state.create_session(token, request.user_id)
+        except errors.WrongPartition as e:
+            # the reviewer-scenario race: ownership was checked at entry,
+            # the batcher await straddled a live split's map flip, and the
+            # session write reached a partition that no longer owns the
+            # user.  The fence rejected it BEFORE any state or WAL touch,
+            # so no token is acked that exists on neither partition — the
+            # client re-routes (its challenge is gone here, so the login
+            # restarts at the new owner; a failed attempt, never a lie)
+            await self._redirect_abort(request.user_id, context, str(e))
         except errors.Error as e:
             await context.abort(grpc.StatusCode.INTERNAL, f"Failed to create session: {e}")
 
@@ -731,9 +762,14 @@ class AuthServiceImpl:
                 continue
             serr = session_err_by_index[i]
             if serr is not None:
-                results.append(Result(
-                    success=False, message=f"Failed to create session: {serr}"
-                ))
+                # a write-time fence rejection (live split flipped the map
+                # mid-batch) keeps the entry-check redirect shape so the
+                # client's per-entry re-route handling sees one format
+                if serr.startswith("wrong partition"):
+                    msg = serr
+                else:
+                    msg = f"Failed to create session: {serr}"
+                results.append(Result(success=False, message=msg))
                 n_failure += 1
                 continue
             results.append(Result(
@@ -1095,7 +1131,12 @@ class AuthServiceImpl:
             for i, err in zip(verified, session_errs, strict=True):
                 if err is not None:
                     success[i] = False
-                    work.messages[i] = f"Failed to create session: {err}"
+                    # fence rejections keep the redirect shape (see
+                    # verify_proof_batch) so stream consumers re-route
+                    work.messages[i] = (
+                        err if err.startswith("wrong partition")
+                        else f"Failed to create session: {err}"
+                    )
                     tokens.pop(i, None)
         resp = Resp(
             ids=work.ids,

@@ -53,7 +53,7 @@ import zlib
 from collections.abc import MutableMapping
 from dataclasses import dataclass, field
 
-from ..errors import InvalidParams
+from ..errors import InvalidParams, WrongPartition
 from ..protocol.gadgets import Statement
 from . import metrics
 
@@ -387,6 +387,20 @@ class ServerState:
         # mutations additionally wait until the warm standby has applied
         # the journal up to their sequence number (zero-loss failover)
         self.repl_barrier = None
+        # write-time ownership fence (callable(user_id) -> str | None,
+        # attached where a fleet router exists): re-verifies partition
+        # ownership INSIDE the shard lock, in the same synchronous
+        # section as the mutation itself.  The entry-point ownership
+        # check alone cannot fence multi-await handlers — VerifyProof
+        # awaits the batcher between its check and create_session,
+        # register awaits the shard lock — and a live split's map flip
+        # can land inside any of those awaits.  Because the split's
+        # export -> flip runs with no await and this check-then-mutate
+        # is equally synchronous, event-loop non-interleaving totally
+        # orders the two: a fenced mutation either precedes the export
+        # (and ships with it) or follows the flip (and is rejected with
+        # the redirect message — never acknowledged, never stranded).
+        self.owner_fence = None
         # WAL sequence number the last-restored snapshot covered
         self.restored_wal_seq = 0
         # (seq, byte offset) of the journal at the last snapshot write:
@@ -688,6 +702,33 @@ class ServerState:
         once by ``DurabilityManager.recover`` before serving starts)."""
         self.journal = wal
 
+    def attach_owner_fence(self, fence) -> None:
+        """Install the write-time partition-ownership fence: a SYNCHRONOUS
+        ``callable(user_id) -> str | None`` returning the wrong-partition
+        redirect message when this daemon no longer owns ``user_id``
+        under the live fleet map, else ``None``.  Checked inside the
+        shard lock immediately before every acknowledged user-keyed
+        mutation (see the ``owner_fence`` constructor comment for why
+        the entry-point check alone cannot fence multi-await handlers
+        across a live split's map flip).  Reads and challenge consumes
+        stay unfenced on purpose: removing a stale copy the split
+        already exported cannot lose an acknowledged write, and leaving
+        the consume unfenced lets an in-flight login retry at the new
+        owner with its challenge intact there."""
+        self.owner_fence = fence
+
+    def _fence(self, user_id: str) -> None:
+        """Raise :class:`WrongPartition` when the fence rejects
+        ``user_id``.  Callers hold the mutating shard's lock; the raise
+        precedes the insert/remove funnel AND the journal append, so a
+        fenced mutation leaves no trace in memory or in the WAL."""
+        fence = self.owner_fence
+        if fence is None:
+            return
+        msg = fence(user_id)
+        if msg is not None:
+            raise WrongPartition(msg)
+
     def attach_replication_barrier(self, barrier) -> None:
         """Install a sync-replication barrier: an async callable awaited
         with the journal's sequence number after fsync and before the
@@ -832,6 +873,10 @@ class ServerState:
     async def register_user(self, user_data: UserData) -> None:
         shard = self._shard_for_user(user_data.user_id)
         async with shard.lock:
+            # fence BEFORE the duplicate check: post-flip the source may
+            # still hold the user's stale copy, and "already registered"
+            # from a non-owner would mask the redirect
+            self._fence(user_data.user_id)
             if self._total_users() >= self.max_users:
                 raise InvalidParams(
                     f"Server has reached maximum user capacity ({self.max_users})"
@@ -875,6 +920,7 @@ class ServerState:
     async def create_challenge(self, user_id: str, challenge_id: bytes) -> int:
         shard = self._shard_for_user(user_id)
         async with shard.lock:
+            self._fence(user_id)
             if self._total_challenges() >= self.max_challenges:
                 raise InvalidParams(
                     f"Server has reached maximum challenge capacity ({self.max_challenges})"
@@ -1050,6 +1096,13 @@ class ServerState:
         """Thin wrapper over the bulk form so the two can never desync."""
         msg = (await self.create_sessions([(token, user_id)]))[0]
         if msg is not None:
+            # distinguish the fence rejection so the serving layer can
+            # answer a redirect instead of INTERNAL: ownership moves are
+            # monotone within one flip, so re-asking the fence here is
+            # race-free (still rejected <=> the entry failed the fence)
+            fence = self.owner_fence
+            if fence is not None and fence(user_id) is not None:
+                raise WrongPartition(msg)
             raise InvalidParams(msg)
 
     async def create_sessions(self, pairs: list[tuple[str, str]]) -> list[str | None]:
@@ -1070,6 +1123,12 @@ class ServerState:
             shard = self._shards[idx]
             async with shard.lock:
                 for i, token, user_id in by_shard[idx]:
+                    fence = self.owner_fence
+                    if fence is not None:
+                        fmsg = fence(user_id)
+                        if fmsg is not None:
+                            out[i] = fmsg
+                            continue
                     if self._total_sessions() >= self.max_sessions:
                         out[i] = (
                             f"Server has reached maximum session capacity ({self.max_sessions})"
@@ -1118,6 +1177,12 @@ class ServerState:
             raise InvalidParams("Session not found")
         shard = self._shards[idx]
         async with shard.lock:
+            existing = shard._sessions.get(token)
+            if existing is None:
+                raise InvalidParams("Session not found")
+            # fenced like every acked mutation: revoking only the stale
+            # copy post-flip would ack a revoke the new owner never saw
+            self._fence(existing.user_id)
             data = self._session_remove(shard, token)
             if data is None:
                 raise InvalidParams("Session not found")

@@ -288,6 +288,32 @@ class TestSingleActionRail:
         assert out[0].veto is None
         assert out[1].veto == "single-action"
 
+    def test_runner_up_keeps_eligibility_and_fires_next_tick(self):
+        """REGRESSION (review): only the SELECTED action of a tick may
+        consume its cooldown + hysteresis.  The runner-up vetoed as
+        ``single-action`` never ran — if arming had already stamped its
+        cooldown and reset its hot streak (as it once did inside the
+        ``_decide_*`` helpers), the deferred action would re-pay a full
+        cooldown plus ``act_ticks`` of re-accumulation for nothing."""
+        clock = FakeClock()
+        c = make_controller(clock, lane_open_after_s=1.0, act_ticks=2)
+        c.admission = FakeAdmission()
+        # warm both triggers: paging hot tick 1 of 2, lane-open persistence
+        c.decide(Signals(lanes=[lane("0", "open")], paging=True))
+        clock.advance(2.0)
+        out = c.decide(Signals(lanes=[lane("0", "open")], paging=True))
+        assert [d.action for d in out] == [
+            ACTION_LANE_DRAIN, ACTION_ADMISSION_SHRINK,
+        ]
+        assert out[0].veto is None
+        assert out[1].veto == "single-action"
+        # the vetoed shrink consumed NOTHING: no admission cooldown was
+        # stamped, its hot streak survived — it fires on the very next
+        # tick instead of waiting out 15 s + 2 fresh hot ticks
+        out = c.decide(Signals(paging=True))
+        assert [d.action for d in out] == [ACTION_ADMISSION_SHRINK]
+        assert out[0].veto is None
+
     def test_action_in_flight_vetoes_everything(self):
         clock = FakeClock()
         c = make_controller(clock, act_ticks=1, lane_open_after_s=1.0)
@@ -492,6 +518,98 @@ class TestLiveActuation:
         assert not out[0].fired
         assert c.acting is False
 
+    def test_drain_error_rolls_back_and_retries_after_short_backoff(self):
+        """REGRESSION (review): an actuator exception must give back the
+        cooldown + bookkeeping the commit consumed — the drain never
+        happened, so the lane must not read as drained, and the retry
+        waits ``error_backoff_s``, not a full action cooldown."""
+        clock = FakeClock()
+        c = make_controller(clock, dry_run=False, act_ticks=1,
+                            lane_open_after_s=1.0, error_backoff_s=5.0)
+
+        class FlakyRouter(FakeRouter):
+            broken = True
+
+            def drain_lane(self, label):
+                if self.broken:
+                    raise RuntimeError("boom")
+                return super().drain_lane(label)
+
+        router = FlakyRouter([lane("0", "open")])
+        c.router = router
+        run(c.tick())                     # open seen, persistence starts
+        clock.advance(2.0)
+        out = run(c.tick())               # drain fires, actuator raises
+        assert out[0].veto.startswith("actuator-error")
+        # rollback: the lane is NOT drained, its open persistence survived
+        assert c.status()["drained_lanes"] == []
+        # ... and the retry is gated on the SHORT error backoff: armed
+        # (persistence intact) but cooled within the window
+        clock.advance(1.0)
+        assert run(c.tick())[0].veto == "cooldown"
+        clock.advance(5.0)                # past error_backoff_s
+        router.broken = False
+        out = run(c.tick())
+        assert out[0].fired
+        assert router.drained == ["0"]
+        assert c.status()["drained_lanes"] == ["0"]
+
+    def test_readmit_error_keeps_the_lane_tracked_as_drained(self):
+        clock = FakeClock()
+        c = make_controller(clock, dry_run=False, act_ticks=1,
+                            clear_ticks=1, lane_open_after_s=1.0,
+                            lane_cooldown_s=5.0, error_backoff_s=3.0)
+
+        class FlakyRouter(FakeRouter):
+            broken = False
+
+            def readmit_lane(self, label):
+                if self.broken:
+                    raise RuntimeError("boom")
+                return super().readmit_lane(label)
+
+        router = FlakyRouter([lane("0", "open")])
+        c.router = router
+        run(c.tick())
+        clock.advance(2.0)
+        run(c.tick())                     # drain fires for real
+        assert router.drained == ["0"]
+        router.rows[0]["breaker"] = "closed"
+        router.broken = True
+        clock.advance(6.0)                # past lane_cooldown_s
+        out = run(c.tick())               # readmit fires, actuator raises
+        assert out[0].veto.startswith("actuator-error")
+        # rollback: the lane is STILL drained (the readmit never happened
+        # in the router) — forgetting it here would strand it forever
+        assert c.status()["drained_lanes"] == ["0"]
+        clock.advance(4.0)                # past error_backoff_s
+        router.broken = False
+        out = run(c.tick())
+        assert out[0].fired
+        assert router.readmitted == ["0"]
+        assert c.status()["drained_lanes"] == []
+
+    def test_split_error_backs_off_short_not_the_full_cooldown(self):
+        """A transient split-actuator failure must not burn the 600 s
+        split cooldown: the rollback restores the hot streak and arms
+        only ``error_backoff_s``."""
+        clock = FakeClock()
+        c = make_controller(clock, dry_run=False, act_ticks=2,
+                            error_backoff_s=5.0)
+        # no fleet attached: the split actuator raises on first touch
+        c.collect = lambda: Signals(users=150)  # type: ignore[method-assign]
+        assert run(c.tick()) == []        # hot tick 1 of 2
+        out = run(c.tick())               # hot tick 2: fires, raises
+        assert out[0].veto.startswith("actuator-error")
+        assert c._split_hot == 2          # rollback kept the streak
+        clock.advance(1.0)
+        assert run(c.tick())[0].veto == "cooldown"
+        clock.advance(5.0)                # past error_backoff_s — 594 s
+                                          # BEFORE split_cooldown_s would
+                                          # have released it
+        out = run(c.tick())
+        assert out[0].veto.startswith("actuator-error")  # retried
+
 
 # --- the live split (fast storm leg: split under concurrent traffic) ---------
 
@@ -660,6 +778,186 @@ class TestLiveSplit:
             clock.advance(1.0)
             out = await c.tick()
             assert out and out[0].veto == "cooldown"
+
+        run(main())
+
+    def test_owner_fence_blocks_writer_straddling_the_flip(self, tmp_path):
+        """REGRESSION (review): a handler that checked ownership at entry,
+        awaited (verify_proof parks on the dynamic batcher), and only then
+        minted its session could land the write AFTER the split's map
+        flip — on the source's post-export state, where ``drop_users``
+        discards it while the client holds a success and a token valid on
+        NEITHER partition.  With the write-time owner fence installed the
+        late write raises ``WrongPartition`` INSTEAD of acking: the
+        client gets a redirect and retries at the new owner — an
+        acknowledged write is never silently lost."""
+
+        async def main():
+            from cpzk_tpu.errors import InvalidParams, WrongPartition
+
+            map_path = str(tmp_path / "map.json")
+            PartitionMap.uniform(["127.0.0.1:1"]).store(map_path)
+            state = await _seed_live(self.N)
+            fleet = FleetRouter(PartitionMap.load(map_path), 0,
+                                map_path=map_path)
+
+            def owns(uid):
+                return fleet.map.partition_for(uid).index == fleet.self_index
+
+            # the daemon's fence: ownership under the LIVE map, re-asked
+            # synchronously at write time
+            state.attach_owner_fence(
+                lambda uid: None if owns(uid)
+                else f"wrong partition: user '{uid}' moved"
+            )
+            # pick a seeded user the split WILL move: the successor map
+            # is a pure function of (current map, source, new address)
+            successor, _ = fleet.map.split(0, "127.0.0.1:2")
+            moving = next(
+                f"user-{i:03d}" for i in range(self.N)
+                if successor.partition_for(f"user-{i:03d}").index == 1
+            )
+            tok = state.tag_session_token(moving, "t" * 40)
+            in_await = asyncio.Event()
+            resume = asyncio.Event()
+
+            async def straddling_handler():
+                assert owns(moving)        # entry check passes pre-flip...
+                in_await.set()
+                await resume.wait()        # ...the batcher await, during
+                                           # which the flip lands...
+                await state.create_session(tok, moving)  # ...the late write
+
+            writer = asyncio.create_task(straddling_handler())
+            await in_await.wait()
+            report = await run_live_split(
+                map_path=map_path, source=0, new_address="127.0.0.1:2",
+                state=state, fleet=fleet, segment_bytes=512,
+            )
+            assert report["moved_users"] > 0
+            assert not owns(moving)        # the flip took it away
+            resume.set()
+            with pytest.raises(WrongPartition, match="wrong partition"):
+                await writer               # the ack NEVER happens
+            # ...and the fenced write left no trace: the token is invalid
+            # on the source (and was never exported, so it exists on the
+            # target only if the client retries there — honestly)
+            with pytest.raises(InvalidParams):
+                await state.validate_session(tok)
+
+        run(main())
+
+
+# --- the write-time partition-ownership fence (ServerState.owner_fence) ------
+
+
+class TestOwnerFence:
+    """State-level contract: every acknowledged user-keyed mutation
+    re-checks ownership INSIDE the shard lock, in the same synchronous
+    section as the mutation; reads and challenge consumes stay unfenced
+    on purpose (removing or reading a stale copy the split already
+    exported cannot lose an acknowledged write)."""
+
+    @staticmethod
+    def _only(owner_uid):
+        return lambda uid: (
+            None if uid == owner_uid
+            else f"wrong partition: user '{uid}' is not owned here"
+        )
+
+    def test_fence_rejects_every_acked_mutation(self):
+        async def main():
+            from cpzk_tpu.errors import WrongPartition
+
+            state = ServerState()
+            stmt = make_statement()
+            await state.register_user(UserData("mine", stmt, 1))
+            await state.register_user(UserData("moved", stmt, 1))
+            tok = state.tag_session_token("moved", "s" * 40)
+            await state.create_session(tok, "moved")
+            state.attach_owner_fence(self._only("mine"))
+
+            # register_user — fenced BEFORE the duplicate check, so a
+            # stale post-flip copy answers redirect, not "already
+            # registered"
+            with pytest.raises(WrongPartition):
+                await state.register_user(UserData("moved", stmt, 1))
+            with pytest.raises(WrongPartition):
+                await state.register_user(UserData("stranger", stmt, 1))
+            assert "stranger" not in state._users
+            # create_challenge
+            with pytest.raises(WrongPartition):
+                await state.create_challenge(
+                    "moved", state.tag_challenge_id("moved", b"c" * 32)
+                )
+            # create_session — the scalar wrapper raises, the bulk form
+            # reports the same message per-pair
+            with pytest.raises(WrongPartition):
+                await state.create_session(
+                    state.tag_session_token("moved", "u" * 40), "moved"
+                )
+            msgs = await state.create_sessions([
+                (state.tag_session_token("moved", "v" * 40), "moved"),
+                (state.tag_session_token("mine", "w" * 40), "mine"),
+            ])
+            assert msgs[0].startswith("wrong partition")
+            assert msgs[1] is None
+            # revoke_session — revoking only the stale copy would ack a
+            # revoke the new owner never saw
+            with pytest.raises(WrongPartition):
+                await state.revoke_session(tok)
+            assert await state.validate_session(tok) == "moved"
+
+        run(main())
+
+    def test_consume_and_reads_stay_unfenced(self):
+        async def main():
+            state = ServerState()
+            await state.register_user(UserData("moved", make_statement(), 1))
+            cid = state.tag_challenge_id("moved", b"c" * 32)
+            await state.create_challenge("moved", cid)
+            tok = state.tag_session_token("moved", "t" * 40)
+            await state.create_session(tok, "moved")
+            # flip: this daemon owns nothing any more
+            state.attach_owner_fence(lambda uid: "wrong partition: flipped")
+            # an in-flight login still consumes its (stale) challenge —
+            # the exported copy at the new owner is untouched, so the
+            # retry there succeeds — and a held token still validates
+            got = await state.consume_challenge(cid)
+            assert got.user_id == "moved"
+            assert await state.validate_session(tok) == "moved"
+
+        run(main())
+
+    def test_fenced_mutation_never_reaches_the_journal(self):
+        async def main():
+            from cpzk_tpu.errors import WrongPartition
+
+            class FakeWal:
+                def __init__(self):
+                    self.records = []
+                    self.seq = 0
+
+                def append(self, rtype, payload):
+                    self.records.append(rtype)
+                    self.seq += 1
+
+                def needs_sync(self):
+                    return False
+
+            state = ServerState()
+            wal = FakeWal()
+            state.attach_journal(wal)
+            state.attach_owner_fence(lambda uid: "wrong partition: flipped")
+            with pytest.raises(WrongPartition):
+                await state.register_user(UserData("u", make_statement(), 1))
+            msgs = await state.create_sessions(
+                [(state.tag_session_token("u", "t" * 40), "u")]
+            )
+            assert msgs[0].startswith("wrong partition")
+            # no WAL trace: replay/standby apply can never resurrect a
+            # write that was never acknowledged
+            assert wal.records == []
 
         run(main())
 
@@ -873,6 +1171,44 @@ class TestControllerConfig:
         cfg.controller.act_ticks = 0
         with pytest.raises(ValueError, match="act_ticks"):
             cfg.validate()
+
+    def test_error_backoff_env_override_and_validation(self, monkeypatch):
+        monkeypatch.setenv("SERVER_CONTROLLER_ERROR_BACKOFF_S", "7.5")
+        cfg = ServerConfig.from_env()
+        assert cfg.controller.error_backoff_s == 7.5
+        cfg = ServerConfig()
+        cfg.controller.error_backoff_s = -1.0
+        with pytest.raises(ValueError, match="cooldowns cannot be negative"):
+            cfg.validate()
+
+    def test_controller_config_keys_documented(self):
+        """CI drift guard (pattern from test_opsplane.py): every
+        [controller] knob ships in the TOML example, the .env example,
+        and the operations-doc knob inventory."""
+        import dataclasses
+        import re
+        from pathlib import Path
+
+        root = Path(ROOT)
+        docs = (root / "docs" / "operations.md").read_text()
+        toml_text = (root / "config" / "server.toml.example").read_text()
+        env_text = (root / ".env.example").read_text()
+        keys = [f.name for f in dataclasses.fields(ControllerSettings)]
+        assert keys
+        m = re.search(r"^\[controller\]$", toml_text, re.M)
+        assert m, "[controller] section missing from server.toml.example"
+        body = toml_text[m.end():].split("\n[", 1)[0]
+        for key in keys:
+            assert re.search(rf"^{key}\s*=", body, re.M), (
+                f"[controller] key {key!r} missing from server.toml.example"
+            )
+            assert f"SERVER_CONTROLLER_{key.upper()}" in env_text, (
+                f"SERVER_CONTROLLER_{key.upper()} missing from .env.example"
+            )
+            assert f"`controller.{key}`" in docs, (
+                f"`controller.{key}` missing from the docs/operations.md "
+                "knob inventory"
+            )
 
 
 # --- full-scale storm legs (benches/bench_soak.py --storm, marked slow) ------

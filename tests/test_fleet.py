@@ -241,6 +241,48 @@ class TestEnforcement:
 
         run(main())
 
+    def test_write_time_fence_answers_the_redirect_contract(self):
+        """A ``WrongPartition`` raised by the state's write-time owner
+        fence — the map-flip-lands-mid-handler case the entry check
+        cannot see — surfaces over gRPC exactly like the entry check:
+        FAILED_PRECONDITION with both routing trailers, and the
+        mutation left no trace."""
+
+        async def main():
+            pmap, states, servers, ports = await _two_partition_fleet()
+            u0 = uid_on_partition(pmap, 0)
+            # serve() installs the fence when it gets a fleet at
+            # construction; this harness assigns fleet post-hoc, so
+            # install a fence that rejects u0 even though the entry
+            # check passes — standing in for a flip landing after the
+            # entry check but before the mutation
+            states[0].attach_owner_fence(
+                lambda uid: (
+                    f"wrong partition: user '{uid}' moved"
+                    if uid == u0 else None
+                )
+            )
+            try:
+                stmt = make_statement()
+                eb = Ristretto255.element_to_bytes
+                c = AuthClient(f"127.0.0.1:{ports[0]}")
+                with pytest.raises(grpc.aio.AioRpcError) as exc:
+                    await c.register(u0, eb(stmt.y1), eb(stmt.y2))
+                assert exc.value.code() == grpc.StatusCode.FAILED_PRECONDITION
+                assert "wrong partition" in exc.value.details()
+                tmd = {
+                    k: v for k, v in exc.value.trailing_metadata() or ()
+                }
+                assert tmd["cpzk-partition-map-version"] == "1"
+                assert tmd["cpzk-partition-owner"] == f"127.0.0.1:{ports[0]}"
+                assert u0 not in states[0]._users
+                await c.close()
+            finally:
+                for s in servers:
+                    await s.stop(None)
+
+        run(main())
+
     def test_verify_proof_redirect_never_consumes_challenge(self):
         """The redirect fires BEFORE consume_challenge: the same proof
         re-sent to the owner must still authenticate."""
