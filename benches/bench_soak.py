@@ -28,12 +28,16 @@ in about a minute on one core and is what CI's ``soak-smoke`` job gates
 against the committed ``BENCH_SOAK_BASELINE.json``; the committed
 ``BENCH_SOAK.json`` is a full 1M-user CPU run.
 
-``--storm {herd,brownout,split,crashloop,all}`` (ISSUE 16) switches the
-driver into the failure-storm scenario suite: thundering-herd reconnect
-after a primary SIGKILL, slow-chip lane brownout under the live fleet
-controller, a controller-triggered partition split at full write load,
-and an ingest-shard crash-loop — each asserting zero acked-write loss
-and bounded login burn, with no human action anywhere.
+``--storm {herd,brownout,split,crashloop,rolling,all}`` (ISSUE 16/18)
+switches the driver into the failure-storm scenario suite:
+thundering-herd reconnect after a primary SIGKILL, slow-chip lane
+brownout under the live fleet controller, a controller-triggered
+partition split at full write load, an ingest-shard crash-loop, and the
+upgrade storm — a SIGTERM-driven rolling restart of a 2-partition
+replicated fleet whose coordinated handovers must keep measured
+write-unavailability strictly below the ``lease_ms`` blackout — each
+asserting zero acked-write loss and bounded login burn, with no human
+action anywhere.
 
 Usage::
 
@@ -1038,11 +1042,331 @@ async def storm_crashloop(args) -> dict:
             shutil.rmtree(state_dir, ignore_errors=True)
 
 
+ROLLING_LEASE_MS = 2000.0       # daemon_env lease — the failover blackout
+ROLLING_P99_CEILING_MS = 1500.0  # storm-wide successful-login p99 bound
+ROLLING_PROBE_PERIOD_S = 0.02   # per-partition serial write probe cadence
+
+
+async def storm_rolling(args) -> dict:
+    """Upgrade storm (ISSUE 18): roll a 2-partition replicated fleet one
+    partition at a time under mixed traffic.  Each roll is a SIGTERM to
+    the partition's primary — the daemon runs the coordinated handover
+    (``handover_on_term``) before draining — while a serial write probe
+    per partition measures write-unavailability as the largest gap
+    between consecutive acknowledged writes.  Invariants: zero
+    acked-write loss (strided sample on the rolled fleet), zero
+    post-convergence login errors, successful-login p99 bounded, and
+    measured write-unavailability strictly below the ``lease_ms``
+    blackout an unplanned failover would have cost."""
+    from cpzk_tpu.client import AuthClient
+    from cpzk_tpu.fleet import PartitionMap
+
+    users = max(args.storm_users, 2000)
+    state_dir = tempfile.mkdtemp(prefix="cpzk-storm-rolling-")
+    base_port, base_ops = args.port, args.ops_port
+    n_parts = 2
+    prim = [f"127.0.0.1:{base_port + 2 * i}" for i in range(n_parts)]
+    stby = [f"127.0.0.1:{base_port + 2 * i + 1}" for i in range(n_parts)]
+    procs: dict[str, subprocess.Popen] = {}
+    violations: list[str] = []
+    clients: list = []
+    try:
+        # 2 partitions x replicated pair = 4 daemons (standbys first so
+        # every primary's shipper finds its peer on boot)
+        for i in range(n_parts):
+            sdir = os.path.join(state_dir, f"p{i}-standby")
+            os.makedirs(sdir, exist_ok=True)
+            procs[f"p{i}-standby"] = spawn_daemon(
+                base_port + 2 * i + 1,
+                daemon_env(sdir, users, base_ops + 2 * i + 1,
+                           role="standby"),
+                os.path.join(state_dir, f"p{i}-standby.log"),
+            )
+        for i in range(n_parts):
+            wait_healthy(base_ops + 2 * i + 1)
+        for i in range(n_parts):
+            pdir = os.path.join(state_dir, f"p{i}-primary")
+            os.makedirs(pdir, exist_ok=True)
+            procs[f"p{i}-primary"] = spawn_daemon(
+                base_port + 2 * i,
+                daemon_env(pdir, users, base_ops + 2 * i,
+                           role="primary", peer=stby[i]),
+                os.path.join(state_dir, f"p{i}-primary.log"),
+            )
+        for i in range(n_parts):
+            wait_healthy(base_ops + 2 * i)
+
+        # the authoritative v2 map: primaries + their warm standbys.
+        # Rolls flip it (swap_standby); clients converge through the
+        # UNAVAILABLE->standby dial first and the map refresh second.
+        auth = {"map": PartitionMap.uniform(prim, standbys=stby)}
+
+        def fresh_map():
+            return PartitionMap.from_doc(auth["map"].to_doc())
+
+        rng, provers, y1s, y2s = build_corpus()
+        reg = AuthClient(partition_map=fresh_map())
+        clients.append(reg)
+        done = 0
+        while done < users:
+            n = min(REG_BATCH, users - done)
+            ids = [f"su{done + k}" for k in range(n)]
+            resp = await reg.register_batch(
+                ids,
+                [y1s[(done + k) % POOL] for k in range(n)],
+                [y2s[(done + k) % POOL] for k in range(n)],
+                timeout=120.0,
+            )
+            bad = [r.message for r in resp.results if not r.success]
+            assert not bad, f"registration failed: {bad[:3]}"
+            done += n
+        # async replication: let the corpus tail ship before rolling
+        await asyncio.sleep(2.0)
+
+        stop = asyncio.Event()
+        login_lat_ms: list[float] = []
+        login_err_t: list[float] = []
+
+        async def login_worker(k0: int):
+            client = AuthClient(
+                partition_map=fresh_map(), map_refresh=fresh_map,
+                refresh_jitter_s=0.1, reconnect_damp_s=0.1,
+            )
+            clients.append(client)
+            k = k0
+            while not stop.is_set():
+                uid_n = k % users
+                t0 = time.monotonic()
+                try:
+                    good = await _full_login(
+                        client, f"su{uid_n}", provers[uid_n % POOL], rng,
+                        timeout=5.0,
+                    )
+                    if good:
+                        login_lat_ms.append(
+                            (time.monotonic() - t0) * 1000.0
+                        )
+                    else:
+                        login_err_t.append(time.monotonic())
+                except asyncio.CancelledError:
+                    raise
+                except Exception:  # noqa: BLE001 - the roll IS the churn
+                    login_err_t.append(time.monotonic())
+                k += 7
+                await asyncio.sleep(0.08)
+
+        # serial write probe per partition: uids chosen to route there
+        # (ranges never move during a roll — only addresses swap), one
+        # registration every ROLLING_PROBE_PERIOD_S, acks timestamped so
+        # the largest inter-ack gap IS the write-unavailability window
+        probe_acks: list[list[tuple[float, str, int]]] = [
+            [] for _ in range(n_parts)
+        ]
+
+        async def probe_writer(part: int):
+            client = AuthClient(
+                partition_map=fresh_map(), map_refresh=fresh_map,
+                refresh_jitter_s=0.1, reconnect_damp_s=0.1,
+            )
+            clients.append(client)
+            k = 0
+            pmap = auth["map"]
+            while not stop.is_set():
+                uid = f"probe{k}"
+                k += 1
+                if pmap.partition_for(uid).index != part:
+                    continue
+                pool_idx = k % POOL
+                try:
+                    resp = await client.register(
+                        uid, y1s[pool_idx], y2s[pool_idx], timeout=3.0,
+                    )
+                    if resp.success:
+                        probe_acks[part].append(
+                            (time.monotonic(), uid, pool_idx)
+                        )
+                except asyncio.CancelledError:
+                    raise
+                except Exception:  # noqa: BLE001 - fenced/handing over
+                    pass
+                await asyncio.sleep(ROLLING_PROBE_PERIOD_S)
+
+        workers = [
+            asyncio.ensure_future(login_worker(j * 1013))
+            for j in range(args.storm_clients)
+        ] + [
+            asyncio.ensure_future(probe_writer(i)) for i in range(n_parts)
+        ]
+        await asyncio.sleep(2.0)  # warm traffic on the pre-roll fleet
+
+        # -- the roll: one partition at a time, health-gated ---------------
+        rolls: list[dict] = []
+        for i in range(n_parts):
+            t_term = time.monotonic()
+            procs[f"p{i}-primary"].send_signal(signal.SIGTERM)
+            print(f"# rolling: SIGTERM partition {i} primary",
+                  file=sys.stderr, flush=True)
+            # the gate: the partition must serve writes again (probe ack
+            # after the TERM) before the next partition rolls
+            deadline = t_term + 60.0
+            served_at = None
+            while time.monotonic() < deadline:
+                post = [t for t, _, _ in probe_acks[i] if t > t_term]
+                if post:
+                    served_at = post[0]
+                    break
+                await asyncio.sleep(0.02)
+            if served_at is None:
+                violations.append(
+                    f"partition {i} never served a write within 60s of "
+                    "its primary's SIGTERM — roll aborted"
+                )
+                break
+            # old primary drains and exits; the map flips to the new
+            # primary with the drained node parked as the standby slot
+            try:
+                await asyncio.to_thread(
+                    procs[f"p{i}-primary"].wait, 60
+                )
+            except subprocess.TimeoutExpired:
+                violations.append(
+                    f"partition {i} old primary never exited after "
+                    "handover + drain"
+                )
+            auth["map"] = auth["map"].swap_standby(i)
+            rolls.append({
+                "partition": i,
+                "serve_gap_ms": round((served_at - t_term) * 1000.0, 1),
+                "map_version": auth["map"].version,
+            })
+        t_converged = time.monotonic()
+
+        # post-convergence window: the rolled fleet must serve cleanly
+        await asyncio.sleep(max(args.storm_duration, 3.0))
+        grace = t_converged + 1.0
+        post_conv_errors = len([t for t in login_err_t if t > grace])
+        stop.set()
+        await asyncio.gather(*workers, return_exceptions=True)
+
+        # write-unavailability per partition: largest gap between
+        # consecutive acked probe writes across the whole storm
+        write_unavail_ms = []
+        for part in range(n_parts):
+            acks = [t for t, _, _ in probe_acks[part]]
+            gap = 0.0
+            for a, b in zip(acks, acks[1:]):
+                gap = max(gap, b - a)
+            write_unavail_ms.append(round(gap * 1000.0, 1))
+            if not acks:
+                violations.append(f"partition {part} probe never acked")
+        worst_unavail = max(write_unavail_ms) if write_unavail_ms else None
+
+        if len(rolls) == n_parts:
+            for part, unavail in enumerate(write_unavail_ms):
+                if unavail >= ROLLING_LEASE_MS:
+                    violations.append(
+                        f"partition {part} write-unavailability "
+                        f"{unavail:.0f}ms not below the {ROLLING_LEASE_MS:.0f}ms "
+                        "lease blackout — the handover bought nothing"
+                    )
+        if post_conv_errors:
+            violations.append(
+                f"{post_conv_errors} login errors after the fleet "
+                "converged on the rolled map"
+            )
+        p99 = percentile(login_lat_ms, 99)
+        if p99 > ROLLING_P99_CEILING_MS:
+            violations.append(
+                f"login p99 {p99:.0f}ms > {ROLLING_P99_CEILING_MS:.0f}ms "
+                "ceiling under the roll"
+            )
+
+        # ZERO acked-write loss on the rolled fleet: strided corpus
+        # sample + every Nth acked probe write, through the final map
+        lost = 0
+        sample_n = min(200, users)
+        stride = max(1, users // sample_n)
+        checker = AuthClient(partition_map=fresh_map())
+        clients.append(checker)
+        for j in range(sample_n):
+            k = (j * stride) % users
+            try:
+                if not await _full_login(
+                    checker, f"su{k}", provers[k % POOL], rng, timeout=5.0,
+                ):
+                    lost += 1
+            except Exception:  # noqa: BLE001
+                lost += 1
+        probe_lost = probe_checked = 0
+        for part in range(n_parts):
+            acks = probe_acks[part]
+            for _, uid, pool_idx in acks[:: max(1, len(acks) // 50)]:
+                probe_checked += 1
+                try:
+                    if not await _full_login(
+                        checker, uid, provers[pool_idx], rng, timeout=5.0,
+                    ):
+                        probe_lost += 1
+                except Exception:  # noqa: BLE001
+                    probe_lost += 1
+        if lost:
+            violations.append(
+                f"acked-write loss: {lost}/{sample_n} sampled "
+                "registrations not servable on the rolled fleet"
+            )
+        if probe_lost:
+            violations.append(
+                f"acked-write loss: {probe_lost}/{probe_checked} "
+                "mid-roll probe writes not servable on the rolled fleet"
+            )
+
+        standby_dials = sum(
+            getattr(c, "standby_dials", 0) for c in clients
+        )
+        return {
+            "leg": "rolling",
+            "users": users,
+            "partitions": n_parts,
+            "rolls": rolls,
+            "write_unavail_ms": write_unavail_ms,
+            "worst_write_unavail_ms": worst_unavail,
+            "lease_blackout_ms": ROLLING_LEASE_MS,
+            "login_p99_ms": round(p99, 1),
+            "logins_ok": len(login_lat_ms),
+            "post_convergence_login_errors": post_conv_errors,
+            "probe_acks": [len(a) for a in probe_acks],
+            "standby_dials": standby_dials,
+            "sampled_users_checked": sample_n,
+            "sampled_users_lost": lost,
+            "probe_writes_checked": probe_checked,
+            "probe_writes_lost": probe_lost,
+            "final_map_version": auth["map"].version,
+            "violations": violations,
+        }
+    finally:
+        for c in clients:
+            try:
+                await c.close()
+            except Exception:  # noqa: BLE001
+                pass
+        for proc in procs.values():
+            if proc.poll() is None:
+                proc.send_signal(signal.SIGTERM)
+        for proc in procs.values():
+            try:
+                proc.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+        if not args.keep_state:
+            shutil.rmtree(state_dir, ignore_errors=True)
+
+
 STORMS = {
     "herd": storm_herd,
     "brownout": storm_brownout,
     "split": storm_split,
     "crashloop": storm_crashloop,
+    "rolling": storm_rolling,
 }
 
 
@@ -1060,6 +1384,37 @@ async def run_storms(args) -> int:
         "legs": reports,
         "violations": violations,
     }), flush=True)
+    if args.snapshot and "rolling" in reports:
+        # the rolling roll-vs-blackout numbers belong in BENCH_SOAK.json:
+        # the measured planned-operations cost next to the lease blackout
+        # an unplanned failover would have charged
+        from cpzk_tpu.observability.perf import PerfEntry, write_snapshot
+
+        r = reports["rolling"]
+        entries = [
+            PerfEntry("soak.rolling.write_unavail", "cpu", r["users"],
+                      float(r["worst_write_unavail_ms"] or 0.0), "ms"),
+            PerfEntry("soak.rolling.lease_blackout", "cpu", r["users"],
+                      float(r["lease_blackout_ms"]), "ms"),
+            PerfEntry("soak.rolling.login_p99", "cpu", r["users"],
+                      float(r["login_p99_ms"]), "ms"),
+        ]
+        write_snapshot(args.snapshot, entries, meta={
+            "bench": "bench_soak",
+            "storm": args.storm,
+            "users": r["users"],
+            "platform": "host",
+            "rolling": {
+                "write_unavail_ms": r["write_unavail_ms"],
+                "lease_blackout_ms": r["lease_blackout_ms"],
+                "rolls": r["rolls"],
+                "standby_dials": r["standby_dials"],
+                "post_convergence_login_errors":
+                    r["post_convergence_login_errors"],
+            },
+        })
+        print(f"# perf snapshot written to {args.snapshot}",
+              file=sys.stderr, flush=True)
     if violations:
         for v in violations:
             print(f"# VIOLATION {v}", file=sys.stderr, flush=True)
@@ -1270,7 +1625,8 @@ def main() -> int:
     ap.add_argument("--strict", action="store_true",
                     help="exit nonzero when any soak op errored")
     ap.add_argument("--storm", default=None,
-                    choices=["herd", "brownout", "split", "crashloop", "all"],
+                    choices=["herd", "brownout", "split", "crashloop",
+                             "rolling", "all"],
                     help="run the failure-storm scenario suite instead of "
                          "the throughput soak (nonzero exit on any "
                          "invariant violation)")

@@ -35,7 +35,14 @@ import re
 import threading
 import time
 
-from ..durability.wal import MAX_FRAME_PAYLOAD, encode_record, iter_frames
+from ..durability.wal import (
+    MAX_FRAME_PAYLOAD,
+    WAL_FORMAT_VERSION,
+    NewerFormatError,
+    check_record_format,
+    encode_record,
+    iter_frames,
+)
 from ..server import metrics
 
 __all__ = [
@@ -220,6 +227,16 @@ class ProofLogWriter:
         if self.size:
             try:
                 records, _, _ = read_log(path)
+                # format gate (same contract as WAL recovery): refuse to
+                # append after records stamped newer than this build
+                # writes — naming both versions and the file
+                for rec in records:
+                    try:
+                        check_record_format(rec)
+                    except NewerFormatError as e:
+                        raise NewerFormatError(
+                            f"proof log {path}: {e}"
+                        ) from None
                 if records:
                     self.file_first_seq = int(records[0]["seq"])
                     self.seq = max(self.seq, int(records[-1]["seq"]))
@@ -247,10 +264,11 @@ class ProofLogWriter:
                 self.seq += 1
                 rec = dict(payload)
                 # assigned AFTER the payload merge: a replayed record (or
-                # hostile payload) carrying its own seq/type must never
-                # override the writer's numbering
+                # hostile payload) carrying its own seq/type/fmt must
+                # never override the writer's numbering or format stamp
                 rec["seq"] = self.seq
                 rec["type"] = "proof"
+                rec["fmt"] = WAL_FORMAT_VERSION
                 frames += encode_record(rec)
             os.write(self._fd, frames)
             self.size += len(frames)

@@ -151,6 +151,9 @@ class AuthClient:
         self.map_refresh = map_refresh
         #: wrong-partition re-routes performed (observability/tests).
         self.redirects = 0
+        #: UNAVAILABLE-triggered dials of a partition's warm standby
+        #: (v2 maps only; observability/tests).
+        self.standby_dials = 0
         # herd damping: N clients waking together (a promotion, a map
         # flip) must not hammer /partitionmap or the new primary in one
         # synchronized wave.  Map refreshes are SINGLE-FLIGHT (concurrent
@@ -226,6 +229,24 @@ class AuthClient:
         if self.partition_map is None:
             return self._target
         return self.partition_map.partition_for(user_id).address
+
+    def _standby_for(self, address: str | None) -> str | None:
+        """The warm-standby address paired with ``address`` under a v2
+        map (the failover target when the primary answers UNAVAILABLE),
+        or None on v1 maps / unknown addresses.  Symmetric: the map may
+        already name the standby as the primary (a flipped entry), in
+        which case the *other* address of the pair is returned."""
+        pmap = self.partition_map
+        if pmap is None or not address:
+            return None
+        for p in pmap.partitions:
+            if not p.standby:
+                continue
+            if p.address == address:
+                return p.standby
+            if p.standby == address:
+                return p.address
+        return None
 
     async def _refresh_map(self) -> bool:
         """One bounded, HERD-DAMPED map refresh (called on a redirect):
@@ -375,6 +396,7 @@ class AuthClient:
         # an address whose last answer was UNAVAILABLE
         await self._damp_reconnect(address)
         redirected = 0
+        standby_tried = False
         while True:
             try:
                 response = await stub(
@@ -385,6 +407,20 @@ class AuthClient:
                 code_name = code.name if code is not None else ""
                 if code_name == "UNAVAILABLE":
                     self._mark_down(address)
+                    # v2-map failover: dial the partition's warm standby
+                    # ONCE per logical call, before any retry budget is
+                    # charged — a dead primary mid-handover (or a plain
+                    # crash) costs one extra dial, not a backoff ladder
+                    if not standby_tried:
+                        standby = self._standby_for(address)
+                        if standby is not None and standby != address:
+                            standby_tried = True
+                            self.standby_dials += 1
+                            stub = self._stub(standby, name)
+                            address = standby
+                            rctx = rctx.child()
+                            self.last_context = rctx
+                            continue
                 if (
                     self.partition_map is not None
                     and code_name == "FAILED_PRECONDITION"

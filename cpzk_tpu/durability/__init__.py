@@ -28,8 +28,11 @@ from .recovery import RecoveryReport, quarantine_file, recover_state
 from .wal import (
     MAX_FRAME_PAYLOAD,
     WAL_CRASH_POINTS,
+    WAL_FORMAT_VERSION,
     CrashPoint,
+    NewerFormatError,
     WriteAheadLog,
+    check_record_format,
     encode_record,
     iter_frames,
     read_frames,
@@ -39,9 +42,12 @@ __all__ = [
     "CrashPoint",
     "DurabilityManager",
     "MAX_FRAME_PAYLOAD",
+    "NewerFormatError",
     "RecoveryReport",
     "WAL_CRASH_POINTS",
+    "WAL_FORMAT_VERSION",
     "WriteAheadLog",
+    "check_record_format",
     "encode_record",
     "iter_frames",
     "quarantine_file",

@@ -25,9 +25,15 @@ import os
 import time
 from dataclasses import dataclass
 
+from ..errors import UnsupportedFormat
 from ..observability import get_tracer
 from ..server import metrics
-from .wal import iter_frames, wal_sealed_segments
+from .wal import (
+    NewerFormatError,
+    check_record_format,
+    iter_frames,
+    wal_sealed_segments,
+)
 
 log = logging.getLogger("cpzk_tpu.durability")
 
@@ -118,6 +124,17 @@ async def recover_state(state, snapshot_path: str, wal_path: str) -> RecoveryRep
         if not raw:
             continue
         frecords, valid = iter_frames(raw, prev_seq=prev_seq)
+        # format gate (before anything is replayed): a record stamped
+        # newer than this build refuses the whole boot, loudly — the
+        # file is fine, the binary is downgraded; quarantining would
+        # throw away good data
+        for rec in frecords:
+            try:
+                check_record_format(rec)
+            except NewerFormatError as e:
+                raise NewerFormatError(
+                    f"write-ahead log {fpath}: {e}"
+                ) from None
         if not frecords and valid == 0:
             # nonempty but yields no records: not a torn tail, the file
             # is garbage from byte 0 — quarantine rather than truncate
@@ -175,6 +192,13 @@ async def recover_state(state, snapshot_path: str, wal_path: str) -> RecoveryRep
             report.snapshot_loaded = True
         except asyncio.CancelledError:
             raise
+        except UnsupportedFormat as e:
+            # NOT a quarantine case: the snapshot is from a newer build,
+            # not corrupt — refuse to boot, naming both versions, so the
+            # operator upgrades the binary instead of losing the file
+            raise NewerFormatError(
+                f"state snapshot {snapshot_path}: {e}"
+            ) from e
         except Exception as e:
             report.snapshot_quarantined = quarantine_file(
                 snapshot_path, last_seq or int(time.time())

@@ -71,6 +71,7 @@ import threading
 import time
 import zlib
 
+from ..errors import UnsupportedFormat
 from ..server import metrics
 
 _HEADER = struct.Struct(">II")
@@ -80,6 +81,15 @@ HEADER_BYTES = _HEADER.size
 #: the reader allocate gigabytes (largest real record is a register_user
 #: at a few hundred bytes).
 MAX_FRAME_PAYLOAD = 1 << 20
+
+#: Format version stamped into every record this writer appends (the
+#: ``"fmt"`` key; proof-log records carry the same stamp).  Recovery
+#: refuses a record stamped NEWER than this — a downgraded binary must
+#: never half-understand a newer format and silently misreplay — while
+#: records with no stamp (pre-ISSUE-18 files) keep loading: the absence
+#: of the key IS version 1.  Replay itself ignores unknown keys, so a
+#: same-or-older stamp costs nothing.
+WAL_FORMAT_VERSION = 1
 
 #: The deterministic crash sites a FaultPlan can schedule (see
 #: ``FaultPlan.crash_on``); occurrence indexes count per-site visits.
@@ -129,6 +139,38 @@ def encode_record(rec: dict) -> bytes:
     if len(payload) > MAX_FRAME_PAYLOAD:
         raise ValueError(f"WAL record exceeds {MAX_FRAME_PAYLOAD} bytes")
     return frame_payload(payload)
+
+
+class NewerFormatError(UnsupportedFormat, ValueError):
+    """A record (WAL or proof log) is stamped with a format version newer
+    than this build writes — a downgraded binary looking at a newer
+    file.  Recovery refuses LOUDLY (raises, never quarantines): the file
+    is not corrupt, the binary is old, and silently replaying what it
+    half-understands would be data loss with extra steps.  Subclasses
+    :class:`~cpzk_tpu.errors.UnsupportedFormat` (the shared refusal
+    taxonomy — snapshot version gates raise it too) and ``ValueError``
+    (so pre-existing broad handlers keep their semantics)."""
+
+
+def check_record_format(rec: dict) -> None:
+    """Refuse a record stamped newer than ``WAL_FORMAT_VERSION`` (or with
+    a junk stamp).  Unstamped records pass — pre-stamp files are format
+    version 1 by definition."""
+    fmt = rec.get("fmt")
+    if fmt is None:
+        return
+    if not isinstance(fmt, int) or isinstance(fmt, bool) or fmt < 1:
+        raise NewerFormatError(
+            f"record seq {rec.get('seq')} carries an unintelligible "
+            f"format stamp {fmt!r} (this build writes format "
+            f"{WAL_FORMAT_VERSION})"
+        )
+    if fmt > WAL_FORMAT_VERSION:
+        raise NewerFormatError(
+            f"record seq {rec.get('seq')} is format version {fmt}, newer "
+            f"than this build supports ({WAL_FORMAT_VERSION}) — run a "
+            "binary at least as new as the one that wrote it"
+        )
 
 
 def iter_frames(
@@ -333,7 +375,7 @@ class WriteAheadLog:
             if self._fd is None:
                 raise OSError("write-ahead log is closed")
             seq = self.seq + 1
-            rec = {"seq": seq, "type": rtype}
+            rec = {"seq": seq, "type": rtype, "fmt": WAL_FORMAT_VERSION}
             rec.update(payload)
             frame = encode_record(rec)
             if self._crash("pre_append"):

@@ -30,6 +30,15 @@ class InvalidProofEncoding(InvalidGroupElement):
     deferred parsing is observationally identical to eager parsing."""
 
 
+class UnsupportedFormat(Error):
+    """A persisted artifact (state snapshot, WAL record, proof-log
+    record) carries a format stamp NEWER than this build writes, or an
+    unintelligible one.  Deliberately NOT a quarantine case: the file is
+    not corrupt, the binary is old — recovery refuses to boot, naming
+    both versions, so the operator runs a binary at least as new as the
+    one that wrote the data instead of silently setting it aside."""
+
+
 class WrongPartition(Error):
     """A user-keyed mutation reached a partition that no longer owns the
     user under the live fleet map.  Raised by :class:`ServerState`'s

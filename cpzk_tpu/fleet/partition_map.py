@@ -56,8 +56,14 @@ import tempfile
 import zlib
 from dataclasses import dataclass
 
-#: Schema tag of the serialized map document.
+#: Schema tags of the serialized map document.  v1 routes to one address
+#: per partition; v2 (ISSUE 18) additionally carries the partition's
+#: warm-standby address so clients can fail over / follow a handover
+#: without a map flip.  A v2 map with no standbys serializes as v1 — old
+#: digests (and old readers) stay stable.
 SCHEMA = "cpzk-partition-map/1"
+SCHEMA_V2 = "cpzk-partition-map/2"
+_SCHEMAS = (SCHEMA, SCHEMA_V2)
 
 #: The hash keyspace: crc32 — shared with the state-shard router so one
 #: hash places a user both onto a partition and onto a shard within it.
@@ -88,12 +94,15 @@ def user_hash(user_id: str) -> int:
 @dataclass(frozen=True)
 class Partition:
     """One partition: an index, the serving address of its primary
-    (in a replicated deployment: the pair's stable/VIP address), and the
-    hash ranges it owns (half-open ``[lo, hi)``)."""
+    (in a replicated deployment: the pair's stable/VIP address), the
+    hash ranges it owns (half-open ``[lo, hi)``), and — in a v2 map —
+    the optional address of its warm standby (``None`` on v1 maps and
+    unreplicated partitions)."""
 
     index: int
     address: str
     ranges: tuple[tuple[int, int], ...]
+    standby: str | None = None
 
     def span(self) -> int:
         return sum(hi - lo for lo, hi in self.ranges)
@@ -145,15 +154,27 @@ class PartitionMap:
     # -- construction ------------------------------------------------------
 
     @classmethod
-    def uniform(cls, addresses: list[str], version: int = 1) -> "PartitionMap":
+    def uniform(
+        cls, addresses: list[str], version: int = 1,
+        standbys: list[str | None] | None = None,
+    ) -> "PartitionMap":
         """An initial map: the hash space sliced into ``len(addresses)``
-        equal contiguous ranges, one per address."""
+        equal contiguous ranges, one per address.  ``standbys`` (same
+        length, entries may be ``None``) stamps each partition's warm
+        standby for a replicated fleet (a v2 map)."""
         n = len(addresses)
         if n < 1:
             raise ValueError("a partition map needs at least one address")
+        if standbys is not None and len(standbys) != n:
+            raise ValueError(
+                f"standbys must match addresses ({len(standbys)} != {n})"
+            )
         bounds = [HASH_SPACE * i // n for i in range(n)] + [HASH_SPACE]
         return cls(version, [
-            Partition(i, addr, ((bounds[i], bounds[i + 1]),))
+            Partition(
+                i, addr, ((bounds[i], bounds[i + 1]),),
+                standby=standbys[i] if standbys is not None else None,
+            )
             for i, addr in enumerate(addresses)
         ])
 
@@ -177,21 +198,57 @@ class PartitionMap:
         moved = ((mid, hi),)
         kept = tuple(r for r in src.ranges if r != (lo, hi)) + ((lo, mid),)
         parts = list(self.partitions)
-        parts[source] = Partition(src.index, src.address, kept)
+        parts[source] = Partition(
+            src.index, src.address, kept, standby=src.standby
+        )
         parts.append(Partition(len(parts), new_address, moved))
         return PartitionMap(self.version + 1, parts), moved
+
+    def set_standby(self, index: int, standby: str | None) -> "PartitionMap":
+        """A copy with partition ``index``'s warm-standby address set (or
+        cleared with ``None``), version bumped — the ``fleet set-standby``
+        CLI's operation."""
+        if not 0 <= index < len(self.partitions):
+            raise ValueError(f"no partition {index} in map v{self.version}")
+        parts = list(self.partitions)
+        p = parts[index]
+        parts[index] = Partition(p.index, p.address, p.ranges,
+                                 standby=standby)
+        return PartitionMap(self.version + 1, parts)
+
+    def swap_standby(self, index: int) -> "PartitionMap":
+        """A copy with partition ``index``'s primary and standby addresses
+        swapped, version bumped — the map flip after a coordinated
+        handover (the old standby now serves; the restarted old primary
+        comes back as the standby)."""
+        if not 0 <= index < len(self.partitions):
+            raise ValueError(f"no partition {index} in map v{self.version}")
+        p = self.partitions[index]
+        if not p.standby:
+            raise ValueError(
+                f"partition {index} has no standby to swap with"
+            )
+        parts = list(self.partitions)
+        parts[index] = Partition(p.index, p.standby, p.ranges,
+                                 standby=p.address)
+        return PartitionMap(self.version + 1, parts)
 
     # -- (de)serialization -------------------------------------------------
 
     def to_doc(self) -> dict:
+        # the standby key (and the /2 schema tag) appear only when some
+        # partition actually has one: a standby-free map round-trips to
+        # the exact v1 document, digest included
+        has_standby = any(p.standby for p in self.partitions)
         doc = {
-            "schema": SCHEMA,
+            "schema": SCHEMA_V2 if has_standby else SCHEMA,
             "version": self.version,
             "partitions": [
                 {
                     "index": p.index,
                     "address": p.address,
                     "ranges": [[lo, hi] for lo, hi in p.ranges],
+                    **({"standby": p.standby} if p.standby else {}),
                 }
                 for p in self.partitions
             ],
@@ -213,7 +270,7 @@ class PartitionMap:
         try:
             if not isinstance(doc, dict):
                 raise ValueError("partition map must be a JSON object")
-            if doc.get("schema") != SCHEMA:
+            if doc.get("schema") not in _SCHEMAS:
                 raise ValueError(
                     f"unknown partition-map schema: {doc.get('schema')!r}"
                 )
@@ -233,10 +290,19 @@ class PartitionMap:
                 ranges = entry.get("ranges")
                 if not isinstance(ranges, list) or not ranges:
                     raise ValueError("partition ranges must be non-empty")
+                standby = entry.get("standby")
+                if standby is not None and (
+                    not isinstance(standby, str) or not standby
+                ):
+                    raise ValueError(
+                        "partition standby must be a non-empty string "
+                        "when present"
+                    )
                 parts.append(Partition(
                     int(entry.get("index")),
                     address,
                     tuple((int(lo), int(hi)) for lo, hi in ranges),
+                    standby=standby,
                 ))
             return cls(int(doc.get("version")), parts)
         except ValueError:
@@ -324,6 +390,11 @@ def _validate(version: int, partitions: list[Partition]) -> None:
     for p in partitions:
         if not p.address:
             raise ValueError(f"partition {p.index} has an empty address")
+        if p.standby is not None and p.standby == p.address:
+            raise ValueError(
+                f"partition {p.index} standby equals its primary address "
+                f"({p.address!r})"
+            )
         for lo, hi in p.ranges:
             if not (0 <= lo < hi <= HASH_SPACE):
                 raise ValueError(
@@ -426,6 +497,7 @@ class FleetRouter:
             "map_version": self.map.version,
             "map_digest": self.map.short_digest(),
             "address": me.address,
+            "standby": me.standby,
             "owned_ranges": [[lo, hi] for lo, hi in me.ranges],
             "owned_span_fraction": round(me.span() / HASH_SPACE, 6),
             "redirects": self.redirects,

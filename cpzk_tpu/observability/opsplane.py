@@ -188,6 +188,14 @@ class OpsSources:
         doc["replication"] = (
             replication.status() if replication is not None else None
         )
+        # coordinated-handover bookkeeping (primary side only): stage,
+        # fence watermark, standby applied-seq, last duration + counters
+        doc["handover"] = (
+            replication.handover_status()
+            if replication is not None
+            and hasattr(replication, "handover_status")
+            else None
+        )
 
         audit_log = self.audit_log
         doc["audit"] = audit_log.status() if audit_log is not None else None

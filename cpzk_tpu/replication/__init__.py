@@ -15,7 +15,7 @@ the promotion runbook, and the loss-window table.
 """
 
 from .segments import Segment, seal_segment, split_records, validate_segment
-from .shipper import ReplicationTimeout, SegmentShipper
+from .shipper import HandoverError, ReplicationTimeout, SegmentShipper
 from .standby import SegmentApplier, StandbyReplica, load_epoch, store_epoch
 
 __all__ = [
@@ -25,6 +25,7 @@ __all__ = [
     "validate_segment",
     "SegmentShipper",
     "ReplicationTimeout",
+    "HandoverError",
     "SegmentApplier",
     "StandbyReplica",
     "load_epoch",

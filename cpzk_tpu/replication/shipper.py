@@ -40,7 +40,7 @@ from ..resilience.faults import CrashPoint
 from ..server import metrics
 from .segments import split_records
 from .standby import load_epoch
-from .wire import ReplicationStub, load_replication_pb2
+from .wire import ReplicationStub, load_replication_pb2, make_replication_handler
 
 log = logging.getLogger("cpzk_tpu.replication")
 
@@ -50,6 +50,14 @@ class ReplicationTimeout(RuntimeError):
     (standby down, lagging past ``sync_timeout_ms``, or this primary has
     been fenced).  The mutation is durable locally but NOT replicated —
     the caller must surface the failure, not acknowledge the write."""
+
+
+class HandoverError(RuntimeError):
+    """A coordinated handover could not run or complete (no standby, a
+    stale standby that never reached the fence watermark, a refused
+    promotion, a concurrent handover).  Raised by
+    :meth:`SegmentShipper.run_handover` — the caller falls back to the
+    ordinary path (plain drain + lease failover), loudly."""
 
 
 class SegmentShipper:
@@ -95,6 +103,23 @@ class SegmentShipper:
         self.fenced = False
         self.gap_stalled = False
         self.crashed: BaseException | None = None
+        #: "primary" always — lets ``serve(replica=shipper)`` expose the
+        #: ReplicationService (the Handover entry point) on a primary
+        #: daemon through the same seam as a standby, while ``_admit``'s
+        #: role check keeps admitting auth traffic
+        self.role = "primary"
+        self.health = None  # serve() wires the HealthService here
+        #: set while (and after) a handover fences writes: the address
+        #: the service's redirect trailers point at (the standby)
+        self.redirect_address: str | None = None
+        #: coordinated-handover bookkeeping behind /statusz + /handover
+        self._handover = {
+            "stage": "idle", "fence_seq": 0, "standby_applied_seq": 0,
+        }
+        self.handovers_attempted = 0
+        self.handovers_completed = 0
+        self.handovers_aborted = 0
+        self.last_handover_s: float | None = None
         self._index = 0
         self._task: asyncio.Task | None = None
         self._stop = False
@@ -434,6 +459,251 @@ class SegmentShipper:
             )
         if self.crashed is not None:
             raise ReplicationTimeout("segment shipper crashed")
+
+    # -- coordinated handover (ISSUE 18) -----------------------------------
+
+    def handler(self):
+        """ReplicationService handler for the PRIMARY side: ship/status
+        answer with structural refusals, ``Handover`` (phase "initiate")
+        runs the coordinated handover — what lets ``serve(replica=self)``
+        expose the planned-operations entry point over the same port as
+        auth traffic."""
+        return make_replication_handler(self)
+
+    def _crashpt(self, point: str) -> None:
+        if self._faults is not None and self._faults.take_crash(point):
+            raise CrashPoint(f"{point} during handover")
+
+    def _set_stage(self, stage: str) -> None:
+        self._handover["stage"] = stage
+
+    async def run_handover(self, reason: str = "operator",
+                           timeout_ms: float | None = None) -> dict:
+        """The coordinated primary→standby handover, end to end:
+
+        1. arm the write fence (``ServerState.owner_fence`` — reads and
+           challenge consumes stay open; fenced writes get the standard
+           FAILED_PRECONDITION redirect, pointed at the standby);
+        2. flush and ship the WAL tail, wait for the standby's
+           applied-seq ack at the fence watermark;
+        3. instruct the standby to promote at epoch+1;
+        4. enter deposed-redirecting mode (stay fenced, stop shipping).
+
+        Zero acked-write loss is structural: the fence precedes the
+        journal append, so every acknowledged write has ``seq <=
+        fence_seq`` and the standby applied it before promoting.  Any
+        failure before step 3 completes restores the previous fence and
+        re-raises — the pair keeps serving exactly as before, and a real
+        process death at any stage degrades to ordinary lease failover
+        (``HANDOVER_CRASH_POINTS`` pins every stage).
+        """
+        if self.fenced:
+            raise HandoverError("this primary is already fenced/deposed")
+        if self.crashed is not None:
+            raise HandoverError("segment shipper crashed")
+        if not self.peer:
+            raise HandoverError("no standby attached ([replication] peer)")
+        if self._handover["stage"] in ("fence", "ship_tail", "promote"):
+            raise HandoverError("a handover is already in progress")
+        timeout_s = (
+            timeout_ms if timeout_ms is not None
+            else self.settings.handover_timeout_ms
+        ) / 1000.0
+        self.handovers_attempted += 1
+        metrics.counter("fleet.handover.attempts").inc()
+        t0 = time.monotonic()
+        prev_fence = getattr(self.state, "owner_fence", None)
+        target = self.peer
+        promoted = False
+        try:
+            self._crashpt("pre_handover_fence")
+            # 1. arm the write fence, composed over any fleet fence: a
+            # user another partition owns keeps its fleet redirect, every
+            # user this partition owns redirects to the standby
+            def _handover_fence(uid: str, _prev=prev_fence):
+                if _prev is not None:
+                    msg = _prev(uid)
+                    if msg is not None:
+                        return msg
+                return (
+                    "wrong partition: handover in progress; writes go to "
+                    f"the standby at {target}"
+                )
+
+            if hasattr(self.state, "attach_owner_fence"):
+                self.state.attach_owner_fence(_handover_fence)
+            self.redirect_address = target
+            self._set_stage("fence")
+            self._crashpt("post_handover_fence")
+            # 2. flush + ship the tail; the fence preceded every later
+            # append, so this watermark covers every acknowledged write
+            wal = self.manager.wal
+            if wal is not None:
+                await asyncio.to_thread(wal.sync, True)
+            fence_seq = self._wal_seq()
+            self._handover["fence_seq"] = fence_seq
+            self._set_stage("ship_tail")
+            await self._await_acked(fence_seq, timeout_s)
+            self._handover["standby_applied_seq"] = self.acked_seq
+            self._crashpt("pre_handover_promote")
+            # 3. instruct the standby to promote at epoch+1
+            self._set_stage("promote")
+            stub = self._ensure_stub()
+            resp = await stub.handover(
+                self.pb2.HandoverRequest(
+                    phase="promote", epoch=self.epoch,
+                    fence_seq=fence_seq, reason=reason,
+                ),
+                timeout=timeout_s,
+            )
+            if not resp.ok:
+                raise HandoverError(
+                    f"standby refused promotion: {resp.message}"
+                )
+            promoted = True
+            self._handover["standby_applied_seq"] = int(resp.applied_seq)
+            self._crashpt("post_handover_promote")
+            # 4. deposed-redirecting mode: stop shipping/renewing for
+            # good, keep the fence redirecting writes at the new primary
+            self._fence(int(resp.epoch), "coordinated handover")
+            await self._notify_ack()
+            self._set_stage("deposed")
+            duration = time.monotonic() - t0
+            self.last_handover_s = duration
+            self.handovers_completed += 1
+            metrics.counter("fleet.handover.completed").inc()
+            metrics.histogram("fleet.handover.duration").observe(duration)
+            get_tracer().record_event(
+                "handover", reason=reason, fence_seq=fence_seq,
+                new_epoch=int(resp.epoch), duration_s=duration,
+            )
+            log.warning(
+                "handover complete (%s): standby %s promoted at epoch %d, "
+                "fence watermark seq %d, %.3fs; this node is "
+                "deposed-redirecting and should drain",
+                reason, target, int(resp.epoch), fence_seq, duration,
+            )
+            return {
+                "ok": True, "epoch": int(resp.epoch),
+                "fence_seq": fence_seq,
+                "applied_seq": int(resp.applied_seq),
+                "duration_s": duration, "peer": target,
+            }
+        except BaseException:
+            self.handovers_aborted += 1
+            metrics.counter("fleet.handover.aborted").inc()
+            if promoted:
+                # the standby IS primary now — stay deposed-redirecting;
+                # anything less re-forks history
+                self._fence(self.epoch + 1, "handover abort after promotion")
+                self._set_stage("deposed")
+            else:
+                # nothing irreversible happened: restore the previous
+                # fence and keep serving as the primary (lease renewal
+                # continues; a real death here becomes lease failover)
+                if hasattr(self.state, "attach_owner_fence"):
+                    self.state.attach_owner_fence(prev_fence)
+                self.redirect_address = None
+                self._set_stage("aborted")
+            raise
+
+    async def _await_acked(self, seq: int, timeout_s: float) -> None:
+        """Wait until the standby has applied ``seq`` (the handover's
+        fence-watermark wait — ``wait_replicated`` with the handover
+        deadline instead of the sync-mode one)."""
+        if seq <= self.acked_seq:
+            return
+        wake, cond = self._wake, self._ack_cond
+        if wake is None or cond is None:
+            raise HandoverError("segment shipper is not running")
+        wake.set()
+
+        def _done() -> bool:
+            return (
+                self.acked_seq >= seq
+                or self.fenced
+                or self.crashed is not None
+            )
+
+        try:
+            async with cond:
+                await asyncio.wait_for(cond.wait_for(_done), timeout=timeout_s)
+        except asyncio.TimeoutError:
+            raise HandoverError(
+                f"stale standby: did not reach the fence watermark seq "
+                f"{seq} within {timeout_s * 1000.0:g} ms (applied "
+                f"{self.acked_seq})"
+            ) from None
+        if self.fenced:
+            raise HandoverError("fenced during handover")
+        if self.crashed is not None:
+            raise HandoverError("segment shipper crashed during handover")
+
+    # ReplicationService wire methods (serve(replica=shipper) installs
+    # these next to the auth handlers on a primary daemon)
+
+    async def handover(self, request, context):
+        """Wire entry point: phase "initiate" runs :meth:`run_handover`
+        (the fleet rolling-restart CLI's path); anything else is refused
+        — a primary does not promote."""
+        if request.phase not in ("", "initiate"):
+            return self.pb2.HandoverResponse(
+                ok=False, role="primary", epoch=self.epoch,
+                applied_seq=self._wal_seq(),
+                message=(
+                    "this node is a primary; it answers phase 'initiate' "
+                    f"only (got {request.phase!r})"
+                ),
+            )
+        try:
+            report = await self.run_handover(reason=request.reason or "rpc")
+        except CrashPoint:
+            raise  # the process-death stand-in must stay fatal
+        except Exception as e:
+            return self.pb2.HandoverResponse(
+                ok=False, role="primary", epoch=self.epoch,
+                applied_seq=self._wal_seq(), message=str(e),
+            )
+        return self.pb2.HandoverResponse(
+            ok=True, role="primary", epoch=report["epoch"],
+            applied_seq=report["applied_seq"],
+            message="standby promoted; this node is deposed-redirecting",
+            fence_seq=report["fence_seq"],
+            duration_s=report["duration_s"],
+        )
+
+    async def ship_segment(self, request, context):
+        """A primary never applies shipped segments; the 'fenced' refusal
+        makes a deposed twin shipping at us fence itself."""
+        return self.pb2.ShipSegmentResponse(
+            accepted=False, applied_seq=self._wal_seq(), epoch=self.epoch,
+            message="fenced: this node is a primary, not a standby",
+        )
+
+    async def replication_status(self, request, context):
+        return self.pb2.ReplicationStatusResponse(
+            role="primary", epoch=self.epoch,
+            applied_seq=self._wal_seq(),
+            lag_records=max(0, self._wal_seq() - self.acked_seq),
+            lease_remaining_s=0.0, segments_received=0,
+        )
+
+    def handover_status(self) -> dict:
+        """The ``handover`` block of ``/statusz`` and the REPL's
+        ``/handover`` status line."""
+        return {
+            "stage": self._handover["stage"],
+            "fence_seq": self._handover["fence_seq"],
+            "standby_applied_seq": self._handover["standby_applied_seq"],
+            "last_duration_s": (
+                None if self.last_handover_s is None
+                else round(self.last_handover_s, 4)
+            ),
+            "attempts": self.handovers_attempted,
+            "completed": self.handovers_completed,
+            "aborted": self.handovers_aborted,
+            "redirecting_to": self.redirect_address,
+        }
 
     # -- compaction coupling (DurabilityManager) ---------------------------
 

@@ -471,6 +471,67 @@ class StandbyReplica:
             segments_received=self.applier.segments_received,
         )
 
+    async def handover(self, request, context):
+        """Coordinated-handover wire handler, STANDBY side (phase
+        "promote"): promote at epoch+1 once the local applied sequence
+        number has reached the primary's fence watermark.  The primary
+        has already fenced writes and shipped the WAL tail, so under a
+        healthy pair the watermark is already applied and this is one
+        promotion away; a standby that somehow lags past the watermark
+        refuses rather than promoting with acked writes missing — the
+        primary then aborts, unfences, and the pair degrades to the
+        ordinary path."""
+        del context
+        if request.phase not in ("", "promote"):
+            return self.pb2.HandoverResponse(
+                ok=False, role=self.role, epoch=self.epoch,
+                applied_seq=self.applied_seq,
+                message=(
+                    "this node is a standby; it answers phase 'promote' "
+                    f"only (got {request.phase!r})"
+                ),
+            )
+        if self._faults is not None and self._faults.take_crash(
+            "pre_handover_ack"
+        ):
+            from ..resilience.faults import CrashPoint
+
+            raise CrashPoint("pre_handover_ack during handover promotion")
+        if self.role == "primary":
+            # idempotent retry of a handover whose response was lost
+            return self.pb2.HandoverResponse(
+                ok=True, role="primary", epoch=self.epoch,
+                applied_seq=self.applied_seq, message="already primary",
+                fence_seq=int(request.fence_seq),
+            )
+        if int(request.epoch) < self.epoch:
+            return self.pb2.HandoverResponse(
+                ok=False, role=self.role, epoch=self.epoch,
+                applied_seq=self.applied_seq,
+                message=(
+                    f"fenced: stale handover epoch {int(request.epoch)} < "
+                    f"{self.epoch}"
+                ),
+            )
+        fence_seq = int(request.fence_seq)
+        if self.applied_seq < fence_seq:
+            return self.pb2.HandoverResponse(
+                ok=False, role=self.role, epoch=self.epoch,
+                applied_seq=self.applied_seq,
+                message=(
+                    f"not caught up: applied_seq {self.applied_seq} < "
+                    f"fence watermark {fence_seq}"
+                ),
+            )
+        report = await self.promote(
+            reason=f"handover ({request.reason or 'rpc'})"
+        )
+        return self.pb2.HandoverResponse(
+            ok=True, role=self.role, epoch=self.epoch,
+            applied_seq=self.applied_seq,
+            message=report["message"], fence_seq=fence_seq,
+        )
+
     # -- promotion ---------------------------------------------------------
 
     async def promote(self, reason: str = "operator") -> dict:

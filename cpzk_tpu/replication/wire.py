@@ -19,11 +19,12 @@ _METHODS = {
     "ReplicationStatus": (
         "ReplicationStatusRequest", "ReplicationStatusResponse",
     ),
+    "Handover": ("HandoverRequest", "HandoverResponse"),
 }
 
 
 def method_types(pb2):
-    """{rpc name: (request class, response class)} for the two RPCs."""
+    """{rpc name: (request class, response class)} for the three RPCs."""
     return {
         name: (getattr(pb2, req), getattr(pb2, resp))
         for name, (req, resp) in _METHODS.items()
@@ -31,13 +32,18 @@ def method_types(pb2):
 
 
 def make_replication_handler(impl) -> grpc.GenericRpcHandler:
-    """Generic handler for an object with ``ship_segment`` and
-    ``replication_status`` async methods (the :class:`StandbyReplica`)."""
+    """Generic handler for an object with ``ship_segment``,
+    ``replication_status``, and ``handover`` async methods — the
+    :class:`StandbyReplica`, or (since ISSUE 18) the
+    :class:`SegmentShipper`, which answers ship/status with refusals but
+    serves ``Handover`` (phase "initiate") for the planned-operations
+    plane."""
     pb2 = load_replication_pb2()
     types = method_types(pb2)
     methods = {
         "ShipSegment": impl.ship_segment,
         "ReplicationStatus": impl.replication_status,
+        "Handover": impl.handover,
     }
     handlers = {
         name: grpc.unary_unary_rpc_method_handler(
@@ -67,4 +73,9 @@ class ReplicationStub:
             f"/{SERVICE_NAME}/ReplicationStatus",
             request_serializer=types["ReplicationStatus"][0].SerializeToString,
             response_deserializer=types["ReplicationStatus"][1].FromString,
+        )
+        self.handover = channel.unary_unary(
+            f"/{SERVICE_NAME}/Handover",
+            request_serializer=types["Handover"][0].SerializeToString,
+            response_deserializer=types["Handover"][1].FromString,
         )

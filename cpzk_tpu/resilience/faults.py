@@ -54,8 +54,27 @@ FLEET_CRASH_POINTS = (
     "pre_flip", "pre_drain", "pre_finish",
 )
 
+#: Coordinated-handover crash sites (ISSUE 18), one per protocol stage.
+#: Primary side (``SegmentShipper.run_handover``): ``pre_handover_fence``
+#: (nothing armed yet), ``post_handover_fence`` (write fence armed, tail
+#: not shipped), ``pre_handover_promote`` (tail acked at the fence
+#: watermark, promote instruction never sent), ``post_handover_promote``
+#: (standby promoted, deposed-redirect mode not entered).  Standby side
+#: (``StandbyReplica.handover``): ``pre_handover_ack`` (promote
+#: instruction received, nothing done).  A crash at ANY of these must
+#: degrade to ordinary lease failover — handover is an optimization of
+#: the failure path, never a second consistency protocol.
+HANDOVER_CRASH_POINTS = (
+    "pre_handover_fence",
+    "post_handover_fence",
+    "pre_handover_promote",
+    "post_handover_promote",
+    "pre_handover_ack",
+)
+
 ALL_CRASH_POINTS = (
     WAL_CRASH_POINTS + REPLICATION_CRASH_POINTS + FLEET_CRASH_POINTS
+    + HANDOVER_CRASH_POINTS
 )
 
 
@@ -142,7 +161,10 @@ class FaultPlan:
         per segment seal, ``pre_unlink`` once per covered-segment unlink
         under segmented compaction) or a replication site
         (``pre_ship`` / ``mid_segment`` once per shipped segment,
-        ``pre_promote`` once per promotion attempt) — the deterministic
+        ``pre_promote`` once per promotion attempt) or a handover stage
+        (``HANDOVER_CRASH_POINTS`` — once per visit of that stage in
+        ``SegmentShipper.run_handover`` / ``StandbyReplica.handover``) —
+        the deterministic
         stand-in for the process dying at exactly that instruction.  Pass
         the plan as ``WriteAheadLog(..., faults=plan)`` /
         ``DurabilityManager(..., faults=plan)`` /

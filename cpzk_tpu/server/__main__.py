@@ -26,6 +26,7 @@ import sys
 import time
 
 from . import metrics
+from ..errors import UnsupportedFormat
 from .config import RateLimiter, ServerConfig
 from .state import ServerState
 
@@ -261,6 +262,8 @@ HELP = """Available commands:
   /audit       (/au)  proof-log status: path, bytes, seq, pending appends
   /replication (/repl) replication status: role, epoch, lag, lease
   /promote            promote this standby to primary (operator failover)
+  /handover           coordinated primary→standby handover (zero-loss,
+                      bounded write blackout; primary side only)
   /fleet [reload] (/fl) partition-map status; `reload` re-reads the map
                       file and adopts a strictly newer version (splits)
   /controller  (/ctl) fleet controller: mode, cooldowns, last decisions
@@ -526,6 +529,28 @@ async def handle_command(
             "accepts auth traffic; fence the old primary before reviving it",
             False,
         )
+    if word == "/handover":
+        if replication is None or not hasattr(replication, "run_handover"):
+            return (
+                "nothing to hand over (this node is not a replication "
+                "primary)",
+                False,
+            )
+        try:
+            report = await replication.run_handover(reason="operator")
+        except Exception as exc:  # noqa: BLE001 — surface, don't kill REPL
+            return (
+                f"handover ABORTED: {exc} — pair unchanged, lease "
+                "failover still covers a real primary death",
+                False,
+            )
+        return (
+            f"HANDOVER complete in {report['duration_s'] * 1000.0:.0f}ms: "
+            f"standby promoted at epoch={report['epoch']} "
+            f"fence_seq={report['fence_seq']} — this node now redirects "
+            "writes to the new primary; drain and restart it",
+            False,
+        )
     if word in ("/reset", "/rearm"):
         if backend is None or not hasattr(backend, "breaker"):
             return "no failover backend to reset (inline CPU path)", False
@@ -587,6 +612,10 @@ async def load_state(config: ServerConfig):
             nu, ns = await state.restore(config.state_file)
             log.info("restored state snapshot: %d users, %d sessions", nu, ns)
         except asyncio.CancelledError:
+            raise
+        except UnsupportedFormat:
+            # newer-format snapshot: not corrupt, the binary is old —
+            # refuse to boot rather than quarantining live data
             raise
         except Exception as e:
             from ..durability.recovery import quarantine_file
@@ -841,7 +870,9 @@ async def amain(args) -> None:
     server, port = await serve(
         state, limiter, host=config.host, port=config.port,
         backend=backend, batcher=batcher, tls=tls, admission=admission,
-        replica=replica, audit_log=audit_log,
+        # a primary exposes the ReplicationService too (the shipper's
+        # handler serves the Handover RPC; ship/status answer refusals)
+        replica=replica or shipper, audit_log=audit_log,
         stream_window=config.tpu.stream_window,
         stream_entry_deadline_ms=config.tpu.stream_entry_deadline_ms,
         fleet=fleet_router, wire=config.server.wire,
@@ -923,8 +954,18 @@ async def amain(args) -> None:
                 if shard_ingest else "")))
 
     loop = asyncio.get_running_loop()
-    for sig in (signal.SIGINT, signal.SIGTERM):
-        loop.add_signal_handler(sig, stop.set)
+    # SIGTERM is the planned-operations signal: on a primary with a live
+    # standby it runs a coordinated handover before the drain (below).
+    # SIGINT stays a plain stop — ^C in a terminal should not fail over.
+    term_requested = False
+
+    def _on_term() -> None:
+        nonlocal term_requested
+        term_requested = True
+        stop.set()
+
+    loop.add_signal_handler(signal.SIGINT, stop.set)
+    loop.add_signal_handler(signal.SIGTERM, _on_term)
 
     def dump_flightrec() -> None:
         """SIGUSR2: dump the flight-recorder ring as JSON — the live-
@@ -968,6 +1009,32 @@ async def amain(args) -> None:
         repl_task = asyncio.create_task(repl())
 
     await stop.wait()
+
+    # planned operations (ISSUE 18): SIGTERM on a primary with a standby
+    # hands ownership over BEFORE the drain — write blackout is one ship
+    # RTT + promotion instead of a lease_ms failover window, with zero
+    # acked-write loss structurally.  Any failure falls back to the plain
+    # drain, loudly: the standby then takes over via ordinary lease expiry.
+    if (
+        term_requested
+        and shipper is not None
+        and config.replication.handover_on_term
+        and not shipper.fenced
+    ):
+        print(_c("yellow", "SIGTERM: attempting coordinated handover..."))
+        try:
+            report = await shipper.run_handover(reason="sigterm")
+            print(_c(
+                "green",
+                f"handover complete: standby promoted at epoch "
+                f"{report['epoch']} in {report['duration_s'] * 1000.0:.0f}ms",
+            ))
+        except Exception:
+            log.exception(
+                "coordinated handover FAILED; falling back to plain drain "
+                "(no/stale standby?) — the standby takes over via lease "
+                "expiry instead"
+            )
 
     # graceful shutdown: not-serving -> drain -> stop -> final snapshot
     # (server.rs:379-427); background tasks are cancelled AND awaited so

@@ -262,6 +262,15 @@ class ReplicationSettings:
     shards: int = 16             # ServerState lock shards; ids/tokens carry
                                  # the shard tag, so a replicated pair MUST
                                  # agree on this value (1..256)
+    handover_on_term: bool = True     # SIGTERM on a primary with a standby
+                                      # attached runs the coordinated
+                                      # handover before draining (a missing
+                                      # or stale standby falls back to the
+                                      # plain drain, loudly)
+    handover_timeout_ms: float = 5000.0  # deadline for the whole handover
+                                         # (fence-watermark catch-up + the
+                                         # promote exchange); past it the
+                                         # handover aborts and unfences
 
 
 @dataclass
@@ -652,6 +661,12 @@ class ServerConfig:
             self.replication.epoch_file = v
         if (v := get("REPLICATION_SHARDS")) is not None:
             self.replication.shards = int(v)
+        if (v := get("REPLICATION_HANDOVER_ON_TERM")) is not None:
+            self.replication.handover_on_term = v.lower() in (
+                "1", "true", "yes", "on",
+            )
+        if (v := get("REPLICATION_HANDOVER_TIMEOUT_MS")) is not None:
+            self.replication.handover_timeout_ms = float(v)
         # ops plane knobs (HTTP introspection server)
         if (v := get("OPSPLANE_ENABLED")) is not None:
             self.opsplane.enabled = v.lower() in ("1", "true", "yes", "on")
@@ -913,6 +928,10 @@ class ServerConfig:
             raise ValueError("replication.segment_bytes must be positive")
         if self.replication.sync_timeout_ms <= 0:
             raise ValueError("replication.sync_timeout_ms must be positive")
+        if self.replication.handover_timeout_ms <= 0:
+            raise ValueError(
+                "replication.handover_timeout_ms must be positive"
+            )
         if not 1 <= self.replication.shards <= 256:
             raise ValueError(
                 "replication.shards must be in [1, 256] (the shard tag is "

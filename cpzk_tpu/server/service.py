@@ -213,15 +213,31 @@ class AuthServiceImpl:
         """Redirect message when this partition does not own ``user_id``
         under the loaded map, else ``None``.  The single-partition fast
         path is a constant-time no-op inside ``FleetRouter.owns`` — fleet
-        routing must cost the N=1 hot path nothing (perf-gate pinned)."""
+        routing must cost the N=1 hot path nothing (perf-gate pinned).
+
+        A coordinated handover fences the WHOLE node, challenge creates
+        and consumes included: unlike a live split (where the consume
+        stays open so an in-flight login can finish here), the standby
+        holds every challenge shipped before the fence watermark, while
+        a challenge minted here after it replicates nowhere — serving
+        the challenge flow on a fenced/deposed primary strands logins
+        for the whole drain window.  Checking BEFORE the consume keeps
+        the redirect replay-safe: the login retries at the standby with
+        its challenge intact there."""
         fleet = self.fleet
-        if fleet is None or fleet.owns(user_id):
-            return None
-        owner = fleet.owner(user_id)
-        return (
-            f"wrong partition: user is owned by partition {owner.index} "
-            f"at {owner.address} (map v{fleet.map.version})"
-        )
+        if fleet is not None and not fleet.owns(user_id):
+            owner = fleet.owner(user_id)
+            return (
+                f"wrong partition: user is owned by partition {owner.index} "
+                f"at {owner.address} (map v{fleet.map.version})"
+            )
+        target = getattr(self.replica, "redirect_address", None)
+        if target is not None:
+            return (
+                "wrong partition: handover in progress; writes go to "
+                f"the standby at {target}"
+            )
+        return None
 
     def _wrong_partition_counted(self, user_id: str) -> str | None:
         """Per-entry form for the batch/stream paths: the same redirect
@@ -230,7 +246,8 @@ class AuthServiceImpl:
         siblings — the client fans batches out per partition)."""
         msg = self._wrong_partition(user_id)
         if msg is not None:
-            self.fleet.redirects += 1
+            if self.fleet is not None:  # handover fences fleetless pairs too
+                self.fleet.redirects += 1
             metrics.counter("fleet.redirects").inc()
         return msg
 
@@ -256,11 +273,23 @@ class AuthServiceImpl:
         round trip.  Shared by the entry check above and the
         ``errors.WrongPartition`` handlers on the mutation paths."""
         fleet = self.fleet
-        owner = fleet.owner(user_id)
-        md = (
-            (PARTITION_MAP_VERSION_KEY, str(fleet.map.version)),
-            (PARTITION_OWNER_KEY, owner.address),
-        )
+        # during a coordinated handover the write fence redirects at the
+        # STANDBY, not at what the (not-yet-flipped) map says this
+        # partition's owner is — and it must work with no fleet at all
+        # (a plain replicated pair): the shipper carries the target
+        target = getattr(self.replica, "redirect_address", None)
+        if target:
+            md = (
+                (PARTITION_MAP_VERSION_KEY,
+                 str(fleet.map.version) if fleet is not None else "0"),
+                (PARTITION_OWNER_KEY, target),
+            )
+        else:
+            owner = fleet.owner(user_id)
+            md = (
+                (PARTITION_MAP_VERSION_KEY, str(fleet.map.version)),
+                (PARTITION_OWNER_KEY, owner.address),
+            )
         try:
             await context.abort(
                 grpc.StatusCode.FAILED_PRECONDITION, msg,

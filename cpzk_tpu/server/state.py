@@ -53,7 +53,7 @@ import zlib
 from collections.abc import MutableMapping
 from dataclasses import dataclass, field
 
-from ..errors import InvalidParams, WrongPartition
+from ..errors import InvalidParams, UnsupportedFormat, WrongPartition
 from ..protocol.gadgets import Statement
 from . import metrics
 
@@ -1407,9 +1407,22 @@ class ServerState:
 
         # worker thread: a multi-MB snapshot read must not stall the loop
         doc = await _asyncio.to_thread(_read)
-        if doc.get("version") != self.SNAPSHOT_VERSION:
-            raise InvalidParams(
-                f"Unsupported state snapshot version: {doc.get('version')!r}"
+        # forward-compat gate: refuse only snapshots NEWER than this
+        # build writes (naming both versions — the operator needs to know
+        # which binary to run), accept unstamped pre-versioning files
+        # (absence IS version 1) and any older stamp, refuse junk stamps
+        ver = doc.get("version")
+        if ver is not None and (
+            not isinstance(ver, int) or isinstance(ver, bool)
+        ):
+            raise UnsupportedFormat(
+                f"Unsupported state snapshot version: {ver!r}"
+            )
+        if ver is not None and ver > self.SNAPSHOT_VERSION:
+            raise UnsupportedFormat(
+                f"State snapshot is version {ver}, newer than this build "
+                f"supports ({self.SNAPSHOT_VERSION}) — run a binary at "
+                "least as new as the one that wrote it"
             )
         # WAL sequence number this document covers (0 for pre-durability
         # snapshots); recovery replays only journal records beyond it

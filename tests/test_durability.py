@@ -953,3 +953,188 @@ class TestSegmentedWal:
         bad.durability.wal_segment_bytes = -1
         with pytest.raises(ValueError, match="wal_segment_bytes"):
             bad.validate()
+
+
+# --- format-version stamps (ISSUE 18) ----------------------------------------
+
+
+class TestFormatVersionStamps:
+    """Every persisted record carries a ``fmt`` stamp; recovery refuses
+    files NEWER than the build (naming both versions, never
+    quarantining — the file is not corrupt, the binary is old) while
+    unstamped pre-versioning files keep loading."""
+
+    def test_wal_records_and_snapshot_are_stamped(self, tmp_path):
+        from cpzk_tpu.durability import WAL_FORMAT_VERSION
+
+        async def main():
+            state, mgr = make_manager(tmp_path)
+            await mgr.recover()
+            await register(state, 0)
+            await register(state, 1)
+            mgr.wal.sync(True)
+            with open(mgr.wal_path, "rb") as f:
+                records, _ = iter_frames(f.read())
+            assert records and all(
+                r["fmt"] == WAL_FORMAT_VERSION for r in records
+            )
+            await mgr.checkpoint()
+            doc = json.loads((tmp_path / "state.json").read_text())
+            assert doc["version"] == ServerState.SNAPSHOT_VERSION
+            mgr.wal.close()
+
+        run(main())
+
+    def test_recovery_refuses_newer_wal_record(self, tmp_path):
+        from cpzk_tpu.durability import NewerFormatError, WAL_FORMAT_VERSION
+
+        async def main():
+            state, mgr = make_manager(tmp_path)
+            await mgr.recover()
+            await register(state, 0)
+            mgr.wal.sync(True)
+            seq = mgr.wal.seq
+            mgr.wal.close()
+            # a record from a NEWER build appended to the same log
+            stmt = make_statement()
+            eb = Ristretto255.element_to_bytes
+            with open(mgr.wal_path, "ab") as f:
+                f.write(encode_record({
+                    "seq": seq + 1, "type": "register_user",
+                    "fmt": WAL_FORMAT_VERSION + 1, "user_id": "future",
+                    "y1": eb(stmt.y1).hex(), "y2": eb(stmt.y2).hex(),
+                    "registered_at": 1,
+                }))
+            state2, mgr2 = make_manager(tmp_path)
+            with pytest.raises(NewerFormatError) as exc:
+                await mgr2.recover()
+            msg = str(exc.value)
+            assert f"format version {WAL_FORMAT_VERSION + 1}" in msg
+            assert f"({WAL_FORMAT_VERSION})" in msg
+            assert "state.json.wal" in msg  # names the refusing file
+            # refusal, not quarantine: the log is left exactly in place
+            assert os.path.exists(mgr.wal_path)
+            assert not [
+                p for p in os.listdir(tmp_path) if ".corrupt-" in p
+            ]
+
+        run(main())
+
+    def test_unintelligible_wal_stamp_refuses(self, tmp_path):
+        from cpzk_tpu.durability import NewerFormatError
+
+        async def main():
+            state, mgr = make_manager(tmp_path)
+            await mgr.recover()
+            mgr.wal.close()
+            with open(mgr.wal_path, "ab") as f:
+                f.write(encode_record({
+                    "seq": 1, "type": "register_user", "fmt": "two",
+                }))
+            _state2, mgr2 = make_manager(tmp_path)
+            with pytest.raises(NewerFormatError, match="unintelligible"):
+                await mgr2.recover()
+
+        run(main())
+
+    def test_unstamped_wal_records_keep_loading(self, tmp_path):
+        """Pre-versioning logs (no ``fmt`` key) replay exactly as before
+        — absence IS version 1."""
+
+        async def main():
+            stmt = make_statement()
+            eb = Ristretto255.element_to_bytes
+            wal_path = str(tmp_path / "state.json.wal")
+            with open(wal_path, "wb") as f:
+                f.write(encode_record({
+                    "seq": 1, "type": "register_user", "user_id": "old",
+                    "y1": eb(stmt.y1).hex(), "y2": eb(stmt.y2).hex(),
+                    "registered_at": 1,
+                }))
+            state, mgr = make_manager(tmp_path)
+            report = await mgr.recover()
+            assert report.replayed == 1
+            assert (await state.get_user("old")) is not None
+            mgr.wal.close()
+
+        run(main())
+
+    def test_snapshot_newer_version_refuses_not_quarantines(self, tmp_path):
+        from cpzk_tpu.durability import NewerFormatError
+
+        async def main():
+            state, mgr = make_manager(tmp_path)
+            await mgr.recover()
+            await register(state, 0)
+            await mgr.checkpoint()
+            mgr.wal.close()
+            snap = tmp_path / "state.json"
+            doc = json.loads(snap.read_text())
+            doc["version"] = ServerState.SNAPSHOT_VERSION + 1
+            snap.write_text(json.dumps(doc))
+            _state2, mgr2 = make_manager(tmp_path)
+            with pytest.raises(NewerFormatError) as exc:
+                await mgr2.recover()
+            msg = str(exc.value)
+            assert f"version {ServerState.SNAPSHOT_VERSION + 1}" in msg
+            assert "newer than this build" in msg
+            assert "state.json" in msg
+            # the snapshot stays where it is — no quarantine sibling
+            assert snap.exists()
+            assert not [
+                p for p in os.listdir(tmp_path) if ".corrupt-" in p
+            ]
+            # junk stamps refuse too (never half-trusted)
+            doc["version"] = "zzz"
+            snap.write_text(json.dumps(doc))
+            _state3, mgr3 = make_manager(tmp_path)
+            with pytest.raises(NewerFormatError, match="zzz"):
+                await mgr3.recover()
+
+        run(main())
+
+    def test_unstamped_snapshot_keeps_loading(self, tmp_path):
+        async def main():
+            state, mgr = make_manager(tmp_path)
+            await mgr.recover()
+            await register(state, 0)
+            await mgr.checkpoint()
+            mgr.wal.close()
+            snap = tmp_path / "state.json"
+            doc = json.loads(snap.read_text())
+            del doc["version"]
+            snap.write_text(json.dumps(doc))
+            state2, mgr2 = make_manager(tmp_path)
+            report = await mgr2.recover()
+            assert report.snapshot_loaded
+            assert (await state2.get_user("u0")) is not None
+            mgr2.wal.close()
+
+        run(main())
+
+    def test_proof_log_stamped_and_refuses_newer(self, tmp_path):
+        from cpzk_tpu.audit.log import ProofLogWriter
+        from cpzk_tpu.durability import NewerFormatError, WAL_FORMAT_VERSION
+
+        path = str(tmp_path / "proofs.log")
+        w = ProofLogWriter(path)
+        w.append_proofs([{"user_id": "a", "ok": True}])
+        w.close()
+        with open(path, "rb") as f:
+            records, _ = iter_frames(f.read())
+        assert records[0]["fmt"] == WAL_FORMAT_VERSION
+        # reopening over a record from a newer build refuses at init
+        with open(path, "ab") as f:
+            f.write(encode_record({
+                "seq": 2, "type": "proof",
+                "fmt": WAL_FORMAT_VERSION + 1, "user_id": "b",
+            }))
+        with pytest.raises(NewerFormatError) as exc:
+            ProofLogWriter(path)
+        assert "proof log" in str(exc.value)
+        assert f"format version {WAL_FORMAT_VERSION + 1}" in str(exc.value)
+        # the unstamped/older prefix alone reopens fine
+        w2 = ProofLogWriter(str(tmp_path / "other.log"))
+        w2.append_proofs([{"user_id": "c", "ok": False}])
+        w2.close()
+        assert ProofLogWriter(str(tmp_path / "other.log")).seq == 1

@@ -1298,3 +1298,120 @@ class TestFleetConfig:
         FleetRouter(PartitionMap.uniform(["a:1", "b:2"]), 1)
         assert metrics.read("fleet.partition", "g") == 1.0
         assert metrics.read("fleet.map_version", "g") == 1.0
+
+
+# --- standby addresses: map v2 + client failover dial (ISSUE 18) -------------
+
+
+class TestStandbyAddresses:
+    def test_v2_roundtrip_and_v1_byte_compat(self, tmp_path):
+        """A map with standbys serializes as schema /2 and round-trips;
+        a standby-free map stays BYTE-identical to v1 (same schema tag,
+        same digest) so every pre-upgrade reader keeps working."""
+        plain = PartitionMap.uniform(["a:1", "b:2"])
+        assert plain.to_doc()["schema"] == "cpzk-partition-map/1"
+        assert all(p.standby is None for p in plain.partitions)
+
+        v2 = PartitionMap.uniform(
+            ["a:1", "b:2"], standbys=["a:9", None]
+        )
+        doc = v2.to_doc()
+        assert doc["schema"] == "cpzk-partition-map/2"
+        assert doc["partitions"][0]["standby"] == "a:9"
+        assert "standby" not in doc["partitions"][1]
+        path = str(tmp_path / "map.json")
+        v2.store(path)
+        loaded = PartitionMap.load(path)
+        assert loaded.partitions[0].standby == "a:9"
+        assert loaded.partitions[1].standby is None
+        assert loaded.digest == v2.digest
+        # standby-free serialization is digest-stable against v1
+        assert (
+            plain.to_json()
+            == PartitionMap.uniform(["a:1", "b:2"]).to_json()
+        )
+
+    def test_set_and_swap_standby(self):
+        pmap = PartitionMap.uniform(["a:1", "b:2"])
+        with_sb = pmap.set_standby(0, "a:9")
+        assert with_sb.version == pmap.version + 1
+        assert with_sb.partitions[0].standby == "a:9"
+        assert with_sb.partitions[1].standby is None
+        cleared = with_sb.set_standby(0, None)
+        assert cleared.partitions[0].standby is None
+
+        flipped = with_sb.swap_standby(0)
+        assert flipped.version == with_sb.version + 1
+        assert flipped.partitions[0].address == "a:9"
+        assert flipped.partitions[0].standby == "a:1"
+        with pytest.raises(ValueError, match="no standby"):
+            with_sb.swap_standby(1)
+
+    def test_split_preserves_standby(self):
+        pmap = PartitionMap.uniform(["a:1", "b:2"], standbys=["a:9", "b:9"])
+        new_map, _ = pmap.split(0, "c:3")
+        assert new_map.partitions[0].standby == "a:9"
+        assert new_map.partitions[1].standby == "b:9"
+        assert new_map.partitions[2].standby is None  # new partition: none
+
+    def test_v2_rejections(self):
+        with pytest.raises(ValueError, match="standbys"):
+            PartitionMap.uniform(["a:1", "b:2"], standbys=["a:9"])
+        with pytest.raises(ValueError, match="standby"):
+            PartitionMap.uniform(["a:1"], standbys=["a:1"])
+        doc = PartitionMap.uniform(["a:1"], standbys=["a:9"]).to_doc()
+        doc["partitions"][0]["standby"] = 7
+        doc.pop("digest")
+        with pytest.raises(ValueError, match="standby"):
+            PartitionMap.from_doc(doc)
+
+    def test_client_dials_standby_on_unavailable(self):
+        """A dead primary answers UNAVAILABLE; a v2-map client dials the
+        partition's warm standby once — before any retry budget is
+        charged — and the RPC succeeds there."""
+        import socket
+
+        from cpzk_tpu.resilience.retry import RetryBudget, RetryPolicy
+
+        async def main():
+            state = ServerState()
+            server, live = await serve(
+                state, RateLimiter(10**6, 10**6), port=0
+            )
+            s = socket.socket()
+            s.bind(("127.0.0.1", 0))
+            dead = s.getsockname()[1]
+            s.close()
+            try:
+                pmap = PartitionMap.uniform(
+                    [f"127.0.0.1:{dead}"],
+                    standbys=[f"127.0.0.1:{live}"],
+                )
+                policy = RetryPolicy(budget=RetryBudget(tokens=10.0))
+                c = AuthClient(partition_map=pmap, retry=policy)
+                before = policy.budget.tokens
+                p = Prover(params, Witness(Ristretto255.random_scalar(rng)))
+                eb = Ristretto255.element_to_bytes
+                resp = await c.register(
+                    "sb-user", eb(p.statement.y1), eb(p.statement.y2)
+                )
+                assert resp.success, resp.message
+                assert c.standby_dials == 1
+                assert policy.budget.tokens == before  # free dial
+                assert "sb-user" in state._users
+                # the flipped orientation routes too: map already names
+                # the standby as primary, old primary is down
+                flipped = PartitionMap.uniform(
+                    [f"127.0.0.1:{live}"],
+                    standbys=[f"127.0.0.1:{dead}"],
+                )
+                c2 = AuthClient(partition_map=flipped)
+                ch = await c2.create_challenge("sb-user")
+                assert ch.challenge_id
+                assert c2.standby_dials == 0  # primary answered directly
+                await c.close()
+                await c2.close()
+            finally:
+                await server.stop(None)
+
+        run(main())

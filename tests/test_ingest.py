@@ -262,8 +262,22 @@ import cpzk_tpu.server.__main__ as daemon
 args = daemon.parse_args(["--no-repl", "--port", "0"])
 
 async def main():
-    task = asyncio.get_running_loop().create_task(daemon.amain(args))
-    await asyncio.sleep(4.0)  # past the listener bind
+    loop = asyncio.get_running_loop()
+    task = loop.create_task(daemon.amain(args))
+    # poll-until-deadline, not a wall-clock nap: the daemon installs its
+    # signal handlers right after the listener binds, and asyncio's
+    # add_signal_handler swaps SIGTERM off SIG_DFL — the observable
+    # "bound and ready for a clean TERM" marker
+    deadline = loop.time() + 60.0
+    while loop.time() < deadline:
+        if task.done():
+            await task  # surface the boot failure
+            raise AssertionError("daemon exited before being signalled")
+        if signal.getsignal(signal.SIGTERM) is not signal.SIG_DFL:
+            break
+        await asyncio.sleep(0.05)
+    else:
+        raise AssertionError("daemon never installed its signal handlers")
     assert "cpzk_tpu.server.ingest" not in sys.modules, "ingest imported!"
     signal.raise_signal(signal.SIGTERM)
     await task

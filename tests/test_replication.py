@@ -803,3 +803,420 @@ class TestSegmentedWalReplication:
             smgr2.wal.close()
 
         run(main())
+
+
+# --- coordinated handover (ISSUE 18) -----------------------------------------
+
+
+class TestCoordinatedHandover:
+    def test_handover_end_to_end(self, tmp_path):
+        """The tentpole path: fence → ship tail → promote at epoch+1 →
+        deposed-redirecting.  Zero acked-write loss, writes fenced with
+        the standard redirect shape, reads still open, and the promoted
+        standby serves new writes."""
+        from cpzk_tpu.errors import WrongPartition
+        from cpzk_tpu.replication import HandoverError
+
+        async def main():
+            (pstate, pmgr, shipper), (sstate, smgr, replica, sserver, sport) = (
+                await make_pair(tmp_path, auto_promote=False)
+            )
+            try:
+                stmts = {}
+                for i in range(8):
+                    stmts[i] = make_statement()
+                    await pstate.register_user(
+                        UserData(f"user-{i}", stmts[i], 1)
+                    )
+                report = await shipper.run_handover(reason="test")
+                assert report["ok"] and report["epoch"] == 2
+                assert report["fence_seq"] == pmgr.wal.seq
+                assert report["applied_seq"] >= report["fence_seq"]
+                # new primary: promoted, serving, zero loss
+                assert replica.role == "primary" and replica.epoch == 2
+                assert await sstate.user_count() == 8
+                for i in (0, 3, 7):
+                    u = await sstate.get_user(f"user-{i}")
+                    assert u is not None and u.statement == stmts[i]
+                await sstate.register_user(
+                    UserData("post-handover", make_statement(), 1)
+                )
+                # old primary: deposed-redirecting — fenced writes carry
+                # the standby address, reads stay open
+                assert shipper.fenced
+                assert shipper.redirect_address == f"127.0.0.1:{sport}"
+                st = shipper.handover_status()
+                assert st["stage"] == "deposed"
+                assert st["completed"] == 1 and st["aborted"] == 0
+                assert st["last_duration_s"] is not None
+                with pytest.raises(WrongPartition, match="handover"):
+                    await pstate.register_user(
+                        UserData("too-late", make_statement(), 1)
+                    )
+                assert (await pstate.get_user("user-0")) is not None
+                # a second handover is structurally refused
+                with pytest.raises(HandoverError, match="fenced"):
+                    await shipper.run_handover()
+            finally:
+                await shipper.kill()
+                await replica.stop()
+                await sserver.stop(None)
+                pmgr.wal.close()
+                smgr.wal.close()
+
+        run(main())
+
+    def test_stale_standby_aborts_and_primary_keeps_serving(self, tmp_path):
+        """A standby that cannot reach the fence watermark aborts the
+        handover inside the deadline; the fence is rolled back and the
+        primary keeps acknowledging writes — the loud fallback the
+        SIGTERM path relies on."""
+        from cpzk_tpu.replication import HandoverError
+
+        async def main():
+            (pstate, pmgr, shipper), (sstate, smgr, replica, sserver, _p) = (
+                await make_pair(tmp_path, mode="async", auto_promote=False)
+            )
+            try:
+                await pstate.register_user(
+                    UserData("before", make_statement(), 1)
+                )
+                await wait_for(lambda: shipper.acked_seq == pmgr.wal.seq)
+                # standby goes away; async mode keeps acking locally
+                await replica.stop()
+                await sserver.stop(None)
+                await pstate.register_user(
+                    UserData("unshipped", make_statement(), 1)
+                )
+                with pytest.raises(HandoverError, match="stale standby"):
+                    await shipper.run_handover(timeout_ms=400.0)
+                st = shipper.handover_status()
+                assert st["stage"] == "aborted"
+                assert st["aborted"] == 1 and st["completed"] == 0
+                assert not shipper.fenced
+                assert shipper.redirect_address is None
+                # the fence was rolled back: the primary still serves
+                await pstate.register_user(
+                    UserData("after-abort", make_statement(), 1)
+                )
+                assert await pstate.user_count() == 3
+            finally:
+                await shipper.kill()
+                pmgr.wal.close()
+                smgr.wal.close()
+
+        run(main())
+
+    @pytest.mark.parametrize("point", [
+        "pre_handover_fence",
+        "post_handover_fence",
+        "pre_handover_promote",
+        "post_handover_promote",
+    ])
+    def test_primary_crash_at_every_stage_degrades_to_lease_failover(
+        self, tmp_path, point
+    ):
+        """SIGKILL stand-in at each primary-side handover stage: before
+        promotion the pair is left exactly as it was (fence rolled back,
+        primary serving) and a real death degrades to ordinary lease
+        failover; after promotion the old primary stays deposed — no
+        forked history either way, zero acked-write loss."""
+        from cpzk_tpu.errors import WrongPartition
+
+        async def main():
+            plan = FaultPlan().crash_on(point, occurrence=0)
+            (pstate, pmgr, shipper), (sstate, smgr, replica, sserver, _p) = (
+                await make_pair(tmp_path, primary_faults=plan)
+            )
+            try:
+                for i in range(5):
+                    await pstate.register_user(
+                        UserData(f"user-{i}", make_statement(), 1)
+                    )
+                with pytest.raises(CrashPoint):
+                    await shipper.run_handover(reason="crash-test")
+                assert shipper.handovers_aborted == 1
+                # every acked write reached the standby regardless
+                assert await sstate.user_count() == 5
+                if point == "post_handover_promote":
+                    # the standby IS primary; the crashed node must stay
+                    # deposed — anything less re-forks history
+                    assert replica.role == "primary" and replica.epoch == 2
+                    assert shipper.fenced
+                    assert shipper.handover_status()["stage"] == "deposed"
+                    with pytest.raises(WrongPartition):
+                        await pstate.register_user(
+                            UserData("forked", make_statement(), 1)
+                        )
+                    await sstate.register_user(
+                        UserData("new-primary", make_statement(), 1)
+                    )
+                else:
+                    # nothing irreversible happened: fence rolled back,
+                    # primary serving, standby still a standby
+                    assert replica.role == "standby"
+                    assert not shipper.fenced
+                    assert shipper.redirect_address is None
+                    assert shipper.handover_status()["stage"] == "aborted"
+                    await pstate.register_user(
+                        UserData("still-primary", make_statement(), 1)
+                    )
+                    await wait_for(
+                        lambda: replica.applied_seq == pmgr.wal.seq
+                    )
+                    # ...and a real process death now degrades to the
+                    # ordinary lease failover (auto_promote)
+                    await shipper.kill()
+                    await wait_for(
+                        lambda: replica.role == "primary", timeout=10.0
+                    )
+                    assert replica.epoch == 2
+                    assert await sstate.user_count() == 6
+            finally:
+                await shipper.kill()
+                await replica.stop()
+                await sserver.stop(None)
+                pmgr.wal.close()
+                smgr.wal.close()
+
+        run(main())
+
+    def test_standby_crash_at_pre_handover_ack_then_retry_succeeds(
+        self, tmp_path
+    ):
+        """The standby-side crash point fires before any state change:
+        the primary's handover aborts cleanly (fence rolled back, pair
+        unchanged), and a straight retry completes the handover."""
+        import grpc
+
+        async def main():
+            plan = FaultPlan().crash_on("pre_handover_ack", occurrence=0)
+            (pstate, pmgr, shipper), (sstate, smgr, replica, sserver, _p) = (
+                await make_pair(
+                    tmp_path, standby_faults=plan, auto_promote=False
+                )
+            )
+            try:
+                for i in range(3):
+                    await pstate.register_user(
+                        UserData(f"user-{i}", make_statement(), 1)
+                    )
+                with pytest.raises(grpc.RpcError):
+                    await shipper.run_handover(reason="crash-test")
+                # pair unchanged: primary serving, standby a standby
+                assert replica.role == "standby"
+                assert not shipper.fenced
+                assert shipper.handover_status()["stage"] == "aborted"
+                assert shipper.handovers_aborted == 1
+                await pstate.register_user(
+                    UserData("between", make_statement(), 1)
+                )
+                # the crash occurrence is consumed; retry goes through
+                report = await shipper.run_handover(reason="retry")
+                assert report["ok"] and report["epoch"] == 2
+                assert replica.role == "primary"
+                assert await sstate.user_count() == 4
+            finally:
+                await shipper.kill()
+                await replica.stop()
+                await sserver.stop(None)
+                pmgr.wal.close()
+                smgr.wal.close()
+
+        run(main())
+
+    def test_wire_initiate_and_rolling_restart_cli(self, tmp_path):
+        """serve(replica=shipper) exposes Handover next to auth traffic
+        on the primary, and the fleet rolling-restart CLI drives it end
+        to end: health-gate → initiate → poll promotion → flip the map
+        (swap_standby) — the stored map ends v2 with the roles swapped."""
+        import json
+        from types import SimpleNamespace
+
+        from cpzk_tpu.fleet.partition_map import PartitionMap
+        from cpzk_tpu.fleet.__main__ import _roll_fleet
+        from cpzk_tpu.server.service import serve
+
+        async def main():
+            (pstate, pmgr, shipper), (sstate, smgr, replica, sserver, sport) = (
+                await make_pair(tmp_path, auto_promote=False)
+            )
+            pserver, pport = await serve(
+                pstate, RateLimiter(100_000, 100_000), port=0,
+                replica=shipper,
+            )
+            try:
+                for i in range(4):
+                    await pstate.register_user(
+                        UserData(f"user-{i}", make_statement(), 1)
+                    )
+                mpath = tmp_path / "fleet.json"
+                PartitionMap.uniform(
+                    [f"127.0.0.1:{pport}"],
+                    standbys=[f"127.0.0.1:{sport}"],
+                ).store(str(mpath))
+                rc = await _roll_fleet(
+                    SimpleNamespace(map=str(mpath), timeout=15.0)
+                )
+                assert rc == 0
+                assert replica.role == "primary" and replica.epoch == 2
+                assert shipper.fenced
+                flipped = PartitionMap.load(str(mpath))
+                assert flipped.partitions[0].address == f"127.0.0.1:{sport}"
+                assert flipped.partitions[0].standby == f"127.0.0.1:{pport}"
+                assert flipped.version == 2
+                doc = json.loads(mpath.read_text())
+                assert doc["schema"] == "cpzk-partition-map/2"
+                assert await sstate.user_count() == 4
+            finally:
+                await shipper.kill()
+                await replica.stop()
+                await pserver.stop(None)
+                await sserver.stop(None)
+                pmgr.wal.close()
+                smgr.wal.close()
+
+        run(main())
+
+    def test_deposed_primary_redirects_challenge_flow(self, tmp_path):
+        """A fenced/deposed primary redirects the whole challenge flow —
+        CreateChallenge AND the VerifyProof-side consume — before
+        touching state.  The consume must not stay open the way it does
+        across a live split: a challenge minted after the fence
+        watermark replicates nowhere, and one minted at the promoted
+        standby must survive a stale client that still dials the old
+        primary, so the redirect has to go out pre-consume and the
+        retry at the standby finds the challenge intact there."""
+        import grpc
+
+        from cpzk_tpu import Transcript
+        from cpzk_tpu.client import AuthClient
+        from cpzk_tpu.server.service import serve
+
+        async def main():
+            (pstate, pmgr, shipper), (sstate, smgr, replica, sserver, sport) = (
+                await make_pair(tmp_path)
+            )
+            pserver, pport = await serve(
+                pstate, RateLimiter(100_000, 100_000), port=0,
+                replica=shipper,
+            )
+            stale = standby_cli = None
+            try:
+                prover = Prover(params, Witness(Ristretto255.random_scalar(rng)))
+                await pstate.register_user(
+                    UserData("ho-user", prover.statement, 1)
+                )
+                await shipper.run_handover()
+
+                # stale mapless client at the OLD primary: create redirects
+                # with the standby in the owner trailer
+                stale = AuthClient(f"127.0.0.1:{pport}")
+                with pytest.raises(grpc.aio.AioRpcError) as exc:
+                    await stale.create_challenge("ho-user")
+                assert exc.value.code() == grpc.StatusCode.FAILED_PRECONDITION
+                assert "handover in progress" in exc.value.details()
+                tmd = {k: v for k, v in exc.value.trailing_metadata() or ()}
+                assert tmd["cpzk-partition-owner"] == f"127.0.0.1:{sport}"
+
+                # a live challenge at the promoted standby, misdialed to
+                # the deposed primary with a VALID proof: redirected, not
+                # consumed anywhere...
+                standby_cli = AuthClient(f"127.0.0.1:{sport}")
+                ch = await standby_cli.create_challenge("ho-user")
+                cid = bytes(ch.challenge_id)
+                t = Transcript()
+                t.append_context(cid)
+                proof = prover.prove_with_transcript(rng, t)
+                with pytest.raises(grpc.aio.AioRpcError) as exc:
+                    await stale.verify_proof("ho-user", cid, proof.to_bytes())
+                assert exc.value.code() == grpc.StatusCode.FAILED_PRECONDITION
+                assert "handover in progress" in exc.value.details()
+
+                # ...so the SAME proof retried at the standby completes
+                resp = await standby_cli.verify_proof(
+                    "ho-user", cid, proof.to_bytes()
+                )
+                assert resp.success
+            finally:
+                if stale is not None:
+                    await stale.close()
+                if standby_cli is not None:
+                    await standby_cli.close()
+                await shipper.kill()
+                await replica.stop()
+                await pserver.stop(None)
+                await sserver.stop(None)
+                pmgr.wal.close()
+                smgr.wal.close()
+
+        run(main())
+
+    def test_handover_repl_command(self, tmp_path):
+        """`/handover` runs the coordinated handover from the REPL and
+        refuses cleanly on a node that is not a replication primary."""
+        from cpzk_tpu.server.__main__ import handle_command
+
+        async def main():
+            out, _ = await handle_command("/handover", ServerState())
+            assert "nothing to hand over" in out
+
+            (pstate, pmgr, shipper), (sstate, smgr, replica, sserver, _p) = (
+                await make_pair(tmp_path, auto_promote=False)
+            )
+            try:
+                await pstate.register_user(
+                    UserData("alice", make_statement(), 1)
+                )
+                out, _ = await handle_command(
+                    "/handover", sstate, None, smgr, None, replica
+                )
+                assert "nothing to hand over" in out
+                out, _ = await handle_command(
+                    "/handover", pstate, None, pmgr, None, shipper
+                )
+                assert "HANDOVER complete" in out and "epoch=2" in out
+                assert replica.role == "primary"
+                # a second attempt surfaces the abort, not a traceback
+                out, _ = await handle_command(
+                    "/handover", pstate, None, pmgr, None, shipper
+                )
+                assert "ABORTED" in out
+            finally:
+                await shipper.kill()
+                await replica.stop()
+                await sserver.stop(None)
+                pmgr.wal.close()
+                smgr.wal.close()
+
+        run(main())
+
+    def test_statusz_handover_block(self, tmp_path):
+        """/statusz carries the handover block on a primary and None on
+        nodes without one (satellite 3)."""
+        from cpzk_tpu.observability.opsplane import OpsSources
+
+        async def main():
+            (pstate, pmgr, shipper), (sstate, smgr, replica, sserver, _p) = (
+                await make_pair(tmp_path, auto_promote=False)
+            )
+            try:
+                src = OpsSources(state=pstate, replication=shipper)
+                doc = src.statusz()
+                assert doc["handover"]["stage"] == "idle"
+                assert doc["handover"]["attempts"] == 0
+                await shipper.run_handover(reason="test")
+                doc = src.statusz()
+                assert doc["handover"]["stage"] == "deposed"
+                assert doc["handover"]["completed"] == 1
+                # a standby (no handover_status seam) renders null
+                assert OpsSources(state=sstate, replication=replica
+                                  ).statusz()["handover"] is None
+            finally:
+                await shipper.kill()
+                await replica.stop()
+                await sserver.stop(None)
+                pmgr.wal.close()
+                smgr.wal.close()
+
+        run(main())
