@@ -2,14 +2,18 @@
 
 Usage::
 
-    python -m cpzk_tpu.analysis [paths ...] [--json] [--rules IDS]
+    python -m cpzk_tpu.analysis [paths ...] [--format text|json|sarif]
+                                [--json] [--rules IDS]
                                 [--list-rules] [--audit-waivers]
 
 Exit codes: 0 — clean; 1 — findings; 2 — usage or I/O error.  The JSON
 report schema is pinned by tests/test_static_analysis.py (CI uploads it
-as an artifact).  ``--audit-waivers`` lists every live waiver with its
-reason and liveness (a stale one — whose rule would no longer fire — is
-also a WAIVER-002 finding on a normal run).
+as an artifact); ``--format sarif`` emits the same findings as a SARIF
+2.1.0 document so CI can annotate PRs (exit codes and the default human
+output are unchanged — ``--json`` stays an alias for ``--format json``).
+``--audit-waivers`` lists every live waiver with its reason and liveness
+(a stale one — whose rule would no longer fire — is also a WAIVER-002
+finding on a normal run).
 """
 
 from __future__ import annotations
@@ -31,7 +35,16 @@ def build_parser() -> argparse.ArgumentParser:
         "paths", nargs="*", default=["cpzk_tpu"],
         help="files or directories to analyze (default: cpzk_tpu)",
     )
-    p.add_argument("--json", action="store_true", help="machine-readable report")
+    p.add_argument(
+        "--json", action="store_true",
+        help="machine-readable report (alias for --format json)",
+    )
+    p.add_argument(
+        "--format", dest="fmt", choices=("text", "json", "sarif"),
+        default=None,
+        help="output format: text (default), json (the schema-v2 report), "
+        "or sarif (SARIF 2.1.0 for CI annotation)",
+    )
     p.add_argument(
         "--rules", default=None,
         help="comma-separated rule ids to run (default: all)",
@@ -78,8 +91,12 @@ def main(argv: list[str] | None = None) -> int:
             f"({stale} stale)"
         )
         return 1 if stale else 0
-    if args.json:
+    fmt = args.fmt or ("json" if args.json else "text")
+    if fmt == "json":
         json.dump(report.to_dict(), sys.stdout, indent=2, sort_keys=True)
+        print()
+    elif fmt == "sarif":
+        json.dump(report.to_sarif(), sys.stdout, indent=2, sort_keys=True)
         print()
     else:
         for f in report.findings:

@@ -61,6 +61,7 @@ import re
 from dataclasses import dataclass, field
 
 from .contexts import ContextInference, FuncInfo
+from .flows import FlowPass, FuncFlow  # noqa: F401 (re-export for rules)
 
 # -- findings -----------------------------------------------------------------
 
@@ -497,6 +498,8 @@ class Module:
     contexts: dict[ast.AST, FuncInfo] = field(default_factory=dict)
     #: the inference pass itself (rules reuse its resolver/scope maps)
     inference: ContextInference | None = None
+    #: function node -> FuncFlow (await-point event streams, cpzk-lint v3)
+    flows: dict[ast.AST, "FuncFlow"] = field(default_factory=dict)
 
     @property
     def plane(self) -> str:
@@ -546,6 +549,7 @@ def parse_module(source: str, path: str) -> Module | Finding:
     mod.taint = TaintPass().run(tree)
     mod.inference = ContextInference(tree)
     mod.contexts = mod.inference.run()
+    mod.flows = FlowPass(tree).run()
     return mod
 
 
@@ -678,6 +682,66 @@ class Report:
                 "findings": len(self.findings),
                 "waived": len(self.waived),
             },
+        }
+
+    def to_sarif(self) -> dict:
+        """The ``--format sarif`` document (SARIF 2.1.0, minimal profile)
+        so CI can annotate PRs.  Waived findings are carried with
+        ``suppressions`` so annotation UIs hide them by default; exit
+        codes and the human/text output are unaffected."""
+        _load_rules()
+        rules = [
+            {
+                "id": rule_id,
+                "shortDescription": {"text": REGISTRY[rule_id].summary},
+                "fullDescription": {"text": REGISTRY[rule_id].rationale},
+            }
+            for rule_id in all_rule_ids()
+        ]
+
+        def result(f: Finding, suppressed: bool) -> dict:
+            row = {
+                "ruleId": f.rule,
+                "level": "error",
+                "message": {"text": f.message},
+                "locations": [{
+                    "physicalLocation": {
+                        "artifactLocation": {
+                            "uri": f.path.replace(os.sep, "/"),
+                        },
+                        "region": {
+                            "startLine": max(1, f.line),
+                            "startColumn": max(1, f.col + 1),
+                        },
+                    },
+                }],
+            }
+            if suppressed:
+                row["suppressions"] = [{"kind": "inSource"}]
+            return row
+
+        return {
+            "$schema": (
+                "https://raw.githubusercontent.com/oasis-tcs/sarif-spec/"
+                "master/Schemata/sarif-schema-2.1.0.json"
+            ),
+            "version": "2.1.0",
+            "runs": [{
+                "tool": {
+                    "driver": {
+                        "name": "cpzk-lint",
+                        "informationUri": (
+                            "https://github.com/kobby-pentangeli/"
+                            "chaum-pedersen-zkp"
+                        ),
+                        "rules": rules,
+                    },
+                },
+                "results": (
+                    [result(f, False) for f in self.findings]
+                    + [result(f, True) for f in self.waived]
+                ),
+            }],
         }
 
 

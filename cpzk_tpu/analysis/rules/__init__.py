@@ -14,6 +14,7 @@ from __future__ import annotations
 from ..engine import Module, Rule, register
 from . import (  # noqa: F401  (import-for-registration)
     async_discipline,
+    atomicity,
     constant_time,
     framing,
     grpc_abort,

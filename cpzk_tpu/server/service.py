@@ -764,6 +764,7 @@ class AuthServiceImpl:
             tokens[i] = self.state.tag_session_token(
                 contexts[i], token_pool[64 * k: 64 * (k + 1)]
             )
+        # cpzk-lint: disable=AWAIT-001 -- bulk mint: the fence verdict comes back per-entry from create_sessions (re-checked inside its shard lock) and is mapped to redirect-shaped messages below — the batch wire contract has no single redirect to raise
         session_errs = await self.state.create_sessions(
             [(tokens[i], contexts[i]) for i in verified])
         session_err_by_index = dict(zip(verified, session_errs, strict=True))

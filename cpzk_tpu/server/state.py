@@ -963,6 +963,7 @@ class ServerState:
             raise InvalidParams("Invalid or expired challenge")
         return data
 
+    # cpzk-lint: disable=FENCE-001 -- consume stays unfenced on purpose (PR 16/18): burning a stale copy the split already exported cannot lose an acked write, and an unfenced consume lets an in-flight login retry at the new owner with its challenge intact there (the serving layer redirects BEFORE consuming)
     async def consume_challenges(self, ids: list[bytes]) -> list[ChallengeData | None]:
         """Bulk consume-once, one lock acquisition per touched shard (the
         batch RPC's hot path: n sequential ``consume_challenge`` awaits
@@ -1009,6 +1010,7 @@ class ServerState:
         # create_challenge record carries, so replay drops them on its own
         return await self._sweep_expired("challenges")
 
+    # cpzk-lint: disable=FENCE-001 -- expiry GC removes only entries past their validity: a post-flip sweep of a moved user's expired entry is a no-op the split drain performs anyway, so ownership never gates garbage collection
     async def _sweep_expired(self, kind: str) -> int:
         """One expiry sweep over the time-wheels: visit only the buckets
         whose span is due, re-check each member against the map under the
@@ -1389,6 +1391,7 @@ class ServerState:
                 self.snapshot_covered_seq, self.snapshot_covered_offset = covered
             return True
 
+    # cpzk-lint: disable=FENCE-001,ACK-001 -- boot-time snapshot load runs single-threaded before serving starts: no fleet map or fence is attached yet, the WAL replay that follows supplies durability, and nothing is acknowledged to any client
     async def restore(self, path: str) -> tuple[int, int]:
         """Load a snapshot into an empty state; returns (users, sessions).
 
